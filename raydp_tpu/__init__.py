@@ -1,0 +1,46 @@
+"""raydp-tpu: a TPU-native single-cluster ETL -> training framework.
+
+Capabilities modeled on RayDP (reference: hezhaozhao-git/raydp): one Python program
+does ETL on a distributed Arrow DataFrame engine and trains JAX models on the same
+cluster with in-memory Arrow data exchange and ownership-transfer semantics — but
+re-architected for TPU: gradient/activation communication is XLA collectives
+(`jax.lax.psum` & friends) compiled into the step function over an ICI/DCN device
+mesh, never a runtime service (NCCL/Gloo/Horovod/MPI) as in the reference.
+
+Public surface parity (reference python/raydp/__init__.py:18-22):
+  raydp.init_spark / stop_spark      -> raydp_tpu.init_etl / stop_etl
+  raydp.spark.spark_dataframe_to_ray_dataset -> raydp_tpu.dataframe_to_dataset
+  raydp.torch.TorchEstimator         -> raydp_tpu.estimator.JaxEstimator (flagship)
+                                        raydp_tpu.estimator.TorchEstimator (parity)
+  raydp.mpi.create_mpi_job           -> raydp_tpu.spmd.create_spmd_job
+"""
+
+__version__ = "0.1.0"
+
+_LAZY = {
+    "init_etl": ("raydp_tpu.etl.session", "init_etl"),
+    "stop_etl": ("raydp_tpu.etl.session", "stop_etl"),
+    # Familiar aliases for users migrating from the reference API.
+    "init_spark": ("raydp_tpu.etl.session", "init_etl"),
+    "stop_spark": ("raydp_tpu.etl.session", "stop_etl"),
+    "dataframe_to_dataset": ("raydp_tpu.exchange.dataset", "dataframe_to_dataset"),
+    "dataset_to_dataframe": ("raydp_tpu.exchange.dataset", "dataset_to_dataframe"),
+    "from_etl_recoverable": ("raydp_tpu.exchange.dataset", "from_etl_recoverable"),
+    "Dataset": ("raydp_tpu.exchange.dataset", "Dataset"),
+    "create_spmd_job": ("raydp_tpu.spmd.job", "create_spmd_job"),
+}
+
+
+def __getattr__(name):
+    if name in _LAZY:
+        import importlib
+
+        module, attr = _LAZY[name]
+        value = getattr(importlib.import_module(module), attr)
+        globals()[name] = value
+        return value
+    raise AttributeError(f"module 'raydp_tpu' has no attribute {name!r}")
+
+
+def __dir__():
+    return sorted(list(globals()) + list(_LAZY))

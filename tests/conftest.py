@@ -1,0 +1,32 @@
+"""Test scaffolding.
+
+Mirrors the reference's test strategy (SURVEY.md §4): real multi-process cluster
+on one machine, no mocks. JAX runs on a virtual 8-device CPU mesh so every
+sharding/collective path is exercised without TPU hardware; the driver's bench
+and dryrun validate the same code on real chips.
+"""
+
+import os
+import sys
+
+# Must be set before jax (or anything importing jax) loads.
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def cpu_mesh_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"
+    return devices
