@@ -1,0 +1,65 @@
+"""Cluster runtime: process actors, placement groups, virtual nodes, ownership.
+
+The native control-plane substrate of the framework — what Ray core is to the
+reference (SURVEY.md L1). See common.py for the wire protocol, head.py for the
+control-plane service, worker.py for the actor host, api.py for the client API.
+"""
+
+from raydp_tpu.cluster.api import (
+    ActorHandle,
+    PlacementGroup,
+    add_node,
+    available_resources,
+    create_placement_group,
+    get,
+    get_actor,
+    head_rpc,
+    init,
+    is_initialized,
+    kill_all_matching,
+    list_actors,
+    nodes,
+    placement_group_table,
+    remove_node,
+    remove_placement_group,
+    session_dir,
+    shutdown,
+    spawn,
+    total_resources,
+)
+from raydp_tpu.cluster.common import (
+    ActorDiedError,
+    ActorState,
+    ClusterError,
+    OwnerDiedError,
+)
+from raydp_tpu.cluster.worker import current_context, exit_actor
+
+__all__ = [
+    "ActorDiedError",
+    "ActorHandle",
+    "ActorState",
+    "ClusterError",
+    "OwnerDiedError",
+    "PlacementGroup",
+    "add_node",
+    "available_resources",
+    "create_placement_group",
+    "current_context",
+    "exit_actor",
+    "get",
+    "get_actor",
+    "head_rpc",
+    "init",
+    "is_initialized",
+    "kill_all_matching",
+    "list_actors",
+    "nodes",
+    "placement_group_table",
+    "remove_node",
+    "remove_placement_group",
+    "session_dir",
+    "shutdown",
+    "spawn",
+    "total_resources",
+]

@@ -1,0 +1,426 @@
+"""Client API for the cluster runtime: init/shutdown, actor spawn/call,
+placement groups, resource queries.
+
+This is the user-facing surface that replaces Ray core for this framework
+(reference substrate, SURVEY.md L1). Handles are plain picklable records, so
+they pass freely between actors — exactly how the reference passes executor
+actor handles around (ObjectStoreWriter.scala:232-256).
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import select
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Sequence
+
+import cloudpickle
+
+from raydp_tpu.cluster.common import (
+    DRIVER_OWNER,
+    SESSION_ENV,
+    ActorDiedError,
+    ActorRecord,
+    ActorSpec,
+    ActorState,
+    ClusterError,
+    actor_sock_path,
+    connect,
+    head_sock_path,
+    recv_frame,
+    rpc,
+    send_frame,
+    wait_for_path,
+)
+
+_lock = threading.RLock()
+_session_dir: Optional[str] = None
+_head_proc: Optional[subprocess.Popen] = None
+
+
+def is_initialized() -> bool:
+    return _session_dir is not None
+
+
+def session_dir() -> str:
+    if _session_dir is None:
+        raise ClusterError("cluster runtime not initialized; call cluster.init()")
+    return _session_dir
+
+
+def head_rpc(method: str, timeout: float = 60.0, **kwargs) -> Any:
+    return rpc(head_sock_path(session_dir()), (method, kwargs), timeout=timeout)
+
+
+def init(
+    num_cpus: Optional[float] = None,
+    memory: Optional[float] = None,
+    resources: Optional[Dict[str, float]] = None,
+    session_root: Optional[str] = None,
+) -> str:
+    """Start (or join) a session. Inside an actor process this attaches to the
+    existing session from the environment — mirroring how Ray workers join the
+    cluster they were spawned into."""
+    global _session_dir, _head_proc
+    with _lock:
+        if _session_dir is not None:
+            return _session_dir
+        env_session = os.environ.get(SESSION_ENV)
+        if env_session:
+            _session_dir = env_session
+            return _session_dir
+        root = session_root or os.path.join(tempfile.gettempdir(), "raydp_tpu")
+        os.makedirs(root, exist_ok=True)
+        _session_dir = tempfile.mkdtemp(prefix="session-", dir=root)
+        default_resources = dict(resources or {})
+        default_resources["CPU"] = float(num_cpus if num_cpus is not None else (os.cpu_count() or 1))
+        default_resources["memory"] = float(memory if memory is not None else (4 << 30))
+        boot = os.path.join(_session_dir, "head_boot.pkl")
+        with open(boot, "wb") as f:
+            cloudpickle.dump((os.getpid(), default_resources), f)
+        head_env = dict(os.environ)
+        # the head (and the actors it spawns) must be able to import raydp_tpu
+        # and user modules no matter where the driver was launched from
+        head_env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        _head_proc = subprocess.Popen(
+            [sys.executable, "-m", "raydp_tpu.cluster.head_main", _session_dir],
+            start_new_session=True,
+            env=head_env,
+        )
+        wait_for_path(head_sock_path(_session_dir), 30, "head socket")
+        atexit.register(shutdown)
+        return _session_dir
+
+
+def shutdown() -> None:
+    global _session_dir, _head_proc
+    with _lock:
+        if _session_dir is None:
+            return
+        if os.environ.get(SESSION_ENV):  # actors never tear the session down
+            _session_dir = None
+            return
+        try:
+            head_rpc("shutdown", timeout=10)
+        except Exception:
+            pass
+        if _head_proc is not None:
+            try:
+                _head_proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                _head_proc.kill()
+            _head_proc = None
+        _session_dir = None
+
+
+# ---------- actors ----------
+
+
+class RemoteMethod:
+    def __init__(self, handle: "ActorHandle", method: str, no_reply: bool = False,
+                 timeout: Optional[float] = None, retries: int = 0):
+        self._handle = handle
+        self._method = method
+        self._no_reply = no_reply
+        self._timeout = timeout
+        self._retries = retries
+
+    def options(self, no_reply: bool = False, timeout: Optional[float] = None,
+                retries: int = 0) -> "RemoteMethod":
+        return RemoteMethod(self._handle, self._method, no_reply, timeout, retries)
+
+    def remote(self, *args, **kwargs) -> "ActorFuture":
+        return self._handle._call(
+            self._method, args, kwargs,
+            no_reply=self._no_reply, timeout=self._timeout, retries=self._retries,
+        )
+
+    def __call__(self, *args, **kwargs):
+        """Synchronous sugar: handle.method(args) == handle.method.remote(...).result()."""
+        return self.remote(*args, **kwargs).result()
+
+
+class ActorFuture:
+    def __init__(self, sock, timeout: Optional[float]):
+        self._sock = sock
+        self._timeout = timeout
+        self._done = False
+        self._value: Any = None
+        self._error: Optional[BaseException] = None
+
+    def result(self, timeout: Optional[float] = None):
+        if not self._done:
+            wait = timeout if timeout is not None else self._timeout
+            if wait is not None:
+                # probe without consuming, so a timeout leaves the future usable
+                readable, _, _ = select.select([self._sock], [], [], wait)
+                if not readable:
+                    raise TimeoutError(f"no reply within {wait}s")
+            self._sock.settimeout(self._timeout or 300.0)
+            try:
+                status, value = recv_frame(self._sock)
+            except BaseException:
+                self._sock.close()
+                self._done = True
+                raise
+            self._sock.close()
+            self._done = True
+            if status == "ok":
+                self._value = value
+            else:
+                self._error = value
+        if self._error is not None:
+            raise self._error
+        return self._value
+
+
+class _ConnectFailed(OSError):
+    """Connection to the actor socket could not be established; the request was
+    never delivered, so retrying cannot double-execute a method."""
+
+
+class _CompletedFuture:
+    def __init__(self, value=None):
+        self._value = value
+
+    def result(self, timeout=None):
+        return self._value
+
+
+def get(futures, timeout: Optional[float] = None):
+    """ray.get-style convenience over one future or a list of futures."""
+    if isinstance(futures, (list, tuple)):
+        return type(futures)(f.result(timeout) for f in futures)
+    return futures.result(timeout)
+
+
+class ActorHandle:
+    """Picklable reference to a named, restartable actor."""
+
+    def __init__(self, session_dir: str, actor_id: str, name: Optional[str] = None):
+        self._session_dir = session_dir
+        self._actor_id = actor_id
+        self._name = name
+        self._cached_sock: Optional[str] = None
+
+    @property
+    def actor_id(self) -> str:
+        return self._actor_id
+
+    @property
+    def name(self) -> Optional[str]:
+        return self._name
+
+    def __reduce__(self):
+        return (ActorHandle, (self._session_dir, self._actor_id, self._name))
+
+    def __getattr__(self, item: str) -> RemoteMethod:
+        if item.startswith("_"):
+            raise AttributeError(item)
+        return RemoteMethod(self, item)
+
+    def _record(self) -> Optional[ActorRecord]:
+        return rpc(
+            head_sock_path(self._session_dir),
+            ("get_actor", {"actor_id": self._actor_id}),
+            timeout=30,
+        )
+
+    def state(self) -> ActorState:
+        record = self._record()
+        if record is None:
+            raise ClusterError(f"actor {self._actor_id} unknown")
+        return record.state
+
+    def wait_ready(self, timeout: float = 120.0) -> "ActorHandle":
+        deadline = time.monotonic() + timeout
+        while True:
+            record = self._record()
+            if record is not None:
+                if record.state == ActorState.ALIVE:
+                    return self
+                if record.state == ActorState.DEAD:
+                    raise ActorDiedError(
+                        f"actor {self._name or self._actor_id} died during start: {record.error}"
+                    )
+            if time.monotonic() > deadline:
+                raise ClusterError(f"timed out waiting for actor {self._name or self._actor_id}")
+            time.sleep(0.05)
+
+    def _try_send(self, sock_path: str, method: str, args, kwargs, no_reply: bool,
+                  timeout: Optional[float]):
+        """Connect-phase failures raise _ConnectFailed (request was never
+        delivered, always safe to retry); send-phase failures propagate raw
+        (the actor may have partially received the request)."""
+        try:
+            sock = connect(sock_path, timeout=timeout or 300.0)
+        except (ConnectionError, FileNotFoundError, OSError) as exc:
+            raise _ConnectFailed(str(exc)) from exc
+        try:
+            send_frame(sock, (method, args, kwargs, no_reply))
+        except BaseException:
+            sock.close()
+            raise
+        if no_reply:
+            sock.close()
+            return _CompletedFuture()
+        return ActorFuture(sock, timeout)
+
+    def _call(self, method: str, args, kwargs, no_reply: bool, timeout: Optional[float],
+              retries: int) -> ActorFuture:
+        if self._cached_sock is not None:
+            try:
+                return self._try_send(self._cached_sock, method, args, kwargs, no_reply, timeout)
+            except _ConnectFailed:
+                self._cached_sock = None  # actor moved/restarted; fall through to head lookup
+        sends_failed = 0
+        deadline = time.monotonic() + (timeout or 300.0)
+        while True:
+            record = self._record()
+            if record is None:
+                raise ClusterError(f"actor {self._actor_id} unknown")
+            if record.state == ActorState.DEAD:
+                raise ActorDiedError(
+                    f"actor {self._name or self._actor_id} is dead: {record.error or 'exited'}"
+                )
+            if record.state == ActorState.ALIVE and record.sock_path:
+                try:
+                    future = self._try_send(
+                        record.sock_path, method, args, kwargs, no_reply, timeout
+                    )
+                    self._cached_sock = record.sock_path
+                    return future
+                except _ConnectFailed:
+                    pass  # never delivered: retry freely until the deadline
+                except (ConnectionError, OSError):
+                    sends_failed += 1
+                    if sends_failed > retries:
+                        raise
+            if time.monotonic() > deadline:
+                raise ClusterError(
+                    f"timed out calling {method} on {self._name or self._actor_id} "
+                    f"(state={record.state})"
+                )
+            time.sleep(0.05)  # PENDING / RESTARTING: wait for the respawn
+
+    def kill(self, no_restart: bool = True) -> None:
+        rpc(
+            head_sock_path(self._session_dir),
+            ("kill_actor", {"actor_id": self._actor_id, "no_restart": no_restart}),
+            timeout=30,
+        )
+
+
+def spawn(
+    cls,
+    *args,
+    name: Optional[str] = None,
+    resources: Optional[Dict[str, float]] = None,
+    num_cpus: float = 0.0,
+    memory: float = 0.0,
+    max_restarts: int = 0,
+    max_concurrency: int = 1,
+    placement_group: Optional[str] = None,
+    bundle_index: int = -1,
+    env: Optional[Dict[str, str]] = None,
+    block: bool = True,
+    **kwargs,
+) -> ActorHandle:
+    """Create an actor process running ``cls(*args, **kwargs)``."""
+    res = dict(resources or {})
+    if num_cpus:
+        res["CPU"] = float(num_cpus)
+    if memory:
+        res["memory"] = float(memory)
+    env = dict(env or {})
+    # actors must be able to import the modules that defined cls and its args
+    env.setdefault("PYTHONPATH", os.pathsep.join(p for p in sys.path if p))
+    spec = ActorSpec(
+        actor_id=f"actor-{uuid.uuid4().hex[:12]}",
+        name=name,
+        cls_blob=cloudpickle.dumps(cls),
+        args_blob=cloudpickle.dumps((args, kwargs)),
+        resources=res,
+        max_restarts=max_restarts,
+        max_concurrency=max_concurrency,
+        placement_group=placement_group,
+        bundle_index=bundle_index,
+        env=env,
+    )
+    head_rpc("create_actor", spec=spec)
+    handle = ActorHandle(session_dir(), spec.actor_id, name)
+    if block:
+        handle.wait_ready()
+    return handle
+
+
+def get_actor(name: str) -> ActorHandle:
+    record = head_rpc("get_actor", name=name)
+    if record is None:
+        raise ClusterError(f"no actor named {name!r}")
+    return ActorHandle(session_dir(), record.actor_id, name)
+
+
+def list_actors() -> List[ActorRecord]:
+    return head_rpc("list_actors")
+
+
+def kill_all_matching(prefix: str) -> None:
+    for record in list_actors():
+        if record.name and record.name.startswith(prefix):
+            ActorHandle(session_dir(), record.actor_id, record.name).kill()
+
+
+# ---------- placement groups ----------
+
+
+class PlacementGroup:
+    def __init__(self, pg_id: str):
+        self.id = pg_id
+
+    def __reduce__(self):
+        return (PlacementGroup, (self.id,))
+
+
+def create_placement_group(
+    bundles: Sequence[Dict[str, float]], strategy: str = "PACK"
+) -> PlacementGroup:
+    pg_id = head_rpc("create_placement_group", bundles=list(bundles), strategy=strategy)
+    return PlacementGroup(pg_id)
+
+
+def remove_placement_group(pg: PlacementGroup) -> None:
+    head_rpc("remove_placement_group", pg_id=pg.id)
+
+
+def placement_group_table() -> Dict[str, Any]:
+    return head_rpc("placement_group_table")
+
+
+# ---------- nodes / resources ----------
+
+
+def add_node(resources: Dict[str, float], node_ip: Optional[str] = None) -> str:
+    return head_rpc("add_node", resources=resources, node_ip=node_ip)
+
+
+def remove_node(node_id: str) -> None:
+    head_rpc("remove_node", node_id=node_id)
+
+
+def nodes() -> List[Any]:
+    return head_rpc("nodes")
+
+
+def total_resources() -> Dict[str, Dict[str, float]]:
+    return head_rpc("total_resources")
+
+
+def available_resources() -> Dict[str, Dict[str, float]]:
+    return head_rpc("available_resources")

@@ -1,0 +1,149 @@
+"""Wire protocol + shared records for the cluster runtime.
+
+The runtime replaces the reference's substrate (Ray actors + GCS; SURVEY.md L1)
+with a small native stack: one *head* process holding cluster state (actors,
+virtual nodes, placement groups, object metadata) and one OS process per actor,
+all talking length-prefixed cloudpickle frames over Unix-domain sockets. On a
+TPU pod this head runs on the coordinator host and the socket layer swaps to
+TCP; the control plane is deliberately tiny because the data plane (gradient
+and activation traffic) is XLA collectives compiled into step functions, never
+these sockets.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import os
+import socket
+import struct
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+import cloudpickle
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 1 << 30
+
+HEAD_SOCK_NAME = "head.sock"
+SESSION_ENV = "RAYDP_TPU_SESSION"
+DRIVER_OWNER = "__driver__"
+
+
+class ClusterError(RuntimeError):
+    pass
+
+
+class ActorDiedError(ClusterError):
+    """The callee actor is dead (crashed past max_restarts or intentionally exited)."""
+
+
+class OwnerDiedError(ClusterError):
+    """An object's owner died and the object was not transferred (parity:
+    ray.exceptions.OwnerDiedError asserted in reference
+    test_data_owner_transfer.py:33-77)."""
+
+
+class ActorState(str, enum.Enum):
+    PENDING = "PENDING"
+    ALIVE = "ALIVE"
+    RESTARTING = "RESTARTING"
+    DEAD = "DEAD"
+
+
+def send_frame(sock: socket.socket, obj: Any) -> None:
+    payload = cloudpickle.dumps(obj)
+    if len(payload) > MAX_FRAME:
+        raise ClusterError(f"frame too large: {len(payload)} bytes")
+    sock.sendall(_LEN.pack(len(payload)) + payload)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    chunks = []
+    while n:
+        chunk = sock.recv(min(n, 1 << 20))
+        if not chunk:
+            raise ConnectionError("peer closed connection")
+        chunks.append(chunk)
+        n -= len(chunk)
+    return b"".join(chunks)
+
+
+def recv_frame(sock: socket.socket) -> Any:
+    (length,) = _LEN.unpack(_recv_exact(sock, _LEN.size))
+    return cloudpickle.loads(_recv_exact(sock, length))
+
+
+def connect(sock_path: str, timeout: Optional[float] = None) -> socket.socket:
+    sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+    sock.settimeout(timeout)
+    sock.connect(sock_path)
+    return sock
+
+
+def rpc(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) -> Any:
+    """One-shot request/response. Raises the remote exception if status != ok."""
+    with connect(sock_path, timeout) as sock:
+        send_frame(sock, request)
+        status, value = recv_frame(sock)
+    if status == "ok":
+        return value
+    raise value
+
+
+def wait_for_path(path: str, timeout: float, what: str) -> None:
+    deadline = time.monotonic() + timeout
+    while not os.path.exists(path):
+        if time.monotonic() > deadline:
+            raise ClusterError(f"timed out waiting for {what} at {path}")
+        time.sleep(0.02)
+
+
+@dataclasses.dataclass
+class ActorSpec:
+    """Everything needed to (re)start an actor process; persisted to the session
+    dir so the head can respawn a crashed actor with the same identity
+    (restart-aware identity, parity: RayDPExecutor restart dance,
+    reference RayDPExecutor.scala:84-96 / RayExecutorUtils.java:63-65)."""
+
+    actor_id: str
+    name: Optional[str]
+    cls_blob: bytes  # cloudpickled class
+    args_blob: bytes  # cloudpickled (args, kwargs)
+    resources: Dict[str, float]
+    max_restarts: int = 0
+    max_concurrency: int = 1
+    placement_group: Optional[str] = None
+    bundle_index: int = -1
+    env: Dict[str, str] = dataclasses.field(default_factory=dict)
+
+
+@dataclasses.dataclass
+class ActorRecord:
+    """Head-side view of one actor, as reported to clients."""
+
+    actor_id: str
+    name: Optional[str]
+    state: ActorState
+    incarnation: int
+    sock_path: Optional[str]
+    node_id: Optional[str]
+    node_ip: Optional[str]
+    restarts_used: int = 0
+    error: Optional[str] = None
+
+
+@dataclasses.dataclass
+class NodeRecord:
+    node_id: str
+    node_ip: str
+    resources: Dict[str, float]
+    alive: bool = True
+
+
+def actor_sock_path(session_dir: str, actor_id: str, incarnation: int) -> str:
+    return os.path.join(session_dir, f"a-{actor_id}-{incarnation}.sock")
+
+
+def head_sock_path(session_dir: str) -> str:
+    return os.path.join(session_dir, HEAD_SOCK_NAME)

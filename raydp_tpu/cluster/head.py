@@ -1,0 +1,642 @@
+"""The head process: cluster control plane.
+
+One small native-substrate service per session holding all mutable cluster
+state: virtual nodes + resources, actor lifecycle (spawn / crash-detect /
+restart-with-same-identity), placement groups, and the object-ownership table
+used by the exchange layer. It fills the role Ray's GCS + raylet play under the
+reference (SURVEY.md L1) and of the reference's RayAppMaster actor-bookkeeping
+(RayAppMaster.scala:127-205) — but is engine-agnostic: the ETL session, the
+estimators and the SPMD launcher are all just clients.
+
+Runs as its own OS process (see head_main) so driver-side JAX compilation can
+never starve the control plane.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+import traceback
+import uuid
+from typing import Any, Dict, List, Optional
+
+from raydp_tpu.cluster.common import (
+    SESSION_ENV,
+    ActorDiedError,
+    ActorRecord,
+    ActorSpec,
+    ActorState,
+    ClusterError,
+    NodeRecord,
+    OwnerDiedError,
+    actor_sock_path,
+    connect,
+    head_sock_path,
+    recv_frame,
+    send_frame,
+)
+
+_EPS = 1e-9
+
+
+class _Bundle:
+    def __init__(self, index: int, resources: Dict[str, float]):
+        self.index = index
+        self.resources = dict(resources)
+        self.remaining = dict(resources)
+        self.node_id: Optional[str] = None
+
+
+class _PlacementGroup:
+    def __init__(self, pg_id: str, bundles: List[Dict[str, float]], strategy: str):
+        self.pg_id = pg_id
+        self.strategy = strategy
+        self.bundles = [_Bundle(i, b) for i, b in enumerate(bundles)]
+        self.next_bundle = 0  # round-robin cursor (parity: RayAppMaster.getNextBundleIndex, scala:315-323)
+
+
+class _Actor:
+    def __init__(self, spec: ActorSpec):
+        self.spec = spec
+        self.state = ActorState.PENDING
+        self.incarnation = 0
+        self.sock_path: Optional[str] = None
+        self.node_id: Optional[str] = None
+        self.scheduled_bundle: int = -1  # bundle actually charged at schedule time
+        self.restarts_used = 0
+        self.proc: Optional[subprocess.Popen] = None
+        self.intentional_exit = False
+        self.error: Optional[str] = None
+        self.pending_respawn = False
+
+    def record(self, node_ip: Optional[str]) -> ActorRecord:
+        return ActorRecord(
+            actor_id=self.spec.actor_id,
+            name=self.spec.name,
+            state=self.state,
+            incarnation=self.incarnation,
+            sock_path=self.sock_path,
+            node_id=self.node_id,
+            node_ip=node_ip,
+            restarts_used=self.restarts_used,
+            error=self.error,
+        )
+
+
+class _ObjectMeta:
+    """Ownership record for one object-store entry (payload lives in /dev/shm,
+    managed by raydp_tpu.store). Parity target: Ray ownership + the reference's
+    ownership-transfer path (ObjectStoreWriter.scala:64-85, dataset.py:135-171)."""
+
+    def __init__(self, object_id: str, owner: str, shm_name: str, size: int, node_id: str):
+        self.object_id = object_id
+        self.owner = owner
+        self.shm_name = shm_name
+        self.size = size
+        self.node_id = node_id
+        self.owner_died = False
+
+
+class Head:
+    def __init__(self, session_dir: str, driver_pid: int, default_resources: Dict[str, float]):
+        self.session_dir = session_dir
+        self.driver_pid = driver_pid
+        self.lock = threading.RLock()
+        self.nodes: Dict[str, NodeRecord] = {}
+        self.node_available: Dict[str, Dict[str, float]] = {}
+        self.actors: Dict[str, _Actor] = {}
+        self.named: Dict[str, str] = {}  # name -> actor_id
+        self.pgs: Dict[str, _PlacementGroup] = {}
+        self.objects: Dict[str, _ObjectMeta] = {}
+        self.shutting_down = False
+        self._next_ip = 2
+        if default_resources:
+            self._add_node(default_resources)
+
+    # ---------- nodes ----------
+
+    def _add_node(self, resources: Dict[str, float], node_ip: Optional[str] = None) -> str:
+        node_id = f"node-{uuid.uuid4().hex[:8]}"
+        if node_ip is None:
+            node_ip = f"127.0.0.{self._next_ip}"
+            self._next_ip += 1
+        res = dict(resources)
+        res.setdefault("CPU", 1.0)
+        res.setdefault("memory", float(4 << 30))
+        res[f"node:{node_ip}"] = 1.0
+        self.nodes[node_id] = NodeRecord(node_id, node_ip, res)
+        self.node_available[node_id] = dict(res)
+        return node_id
+
+    def handle_add_node(self, resources: Dict[str, float], node_ip: Optional[str] = None):
+        with self.lock:
+            return self._add_node(resources, node_ip)
+
+    def handle_remove_node(self, node_id: str):
+        """Kill a virtual node and every actor process on it (elasticity testing,
+        parity: ray.cluster_utils.Cluster.remove_node used at reference
+        test_spark_cluster.py:166-196)."""
+        with self.lock:
+            node = self.nodes.get(node_id)
+            if node is None or not node.alive:
+                raise ClusterError(f"unknown or dead node {node_id}")
+            node.alive = False
+            self.node_available[node_id] = {}
+            for actor in self.actors.values():
+                if actor.node_id == node_id and actor.state in (
+                    ActorState.ALIVE,
+                    ActorState.PENDING,
+                ):
+                    self._kill_proc(actor)
+            # the monitor thread observes the deaths and handles restart/cleanup
+        return True
+
+    def handle_nodes(self):
+        with self.lock:
+            return [n for n in self.nodes.values()]
+
+    def handle_total_resources(self):
+        with self.lock:
+            return {n.node_id: dict(n.resources) for n in self.nodes.values() if n.alive}
+
+    def handle_available_resources(self):
+        with self.lock:
+            return {
+                n_id: dict(avail)
+                for n_id, avail in self.node_available.items()
+                if self.nodes[n_id].alive
+            }
+
+    # ---------- resource math ----------
+
+    @staticmethod
+    def _fits(avail: Dict[str, float], req: Dict[str, float]) -> bool:
+        return all(avail.get(k, 0.0) + _EPS >= v for k, v in req.items())
+
+    @staticmethod
+    def _sub(avail: Dict[str, float], req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            avail[k] = avail.get(k, 0.0) - v
+
+    @staticmethod
+    def _add(avail: Dict[str, float], req: Dict[str, float]) -> None:
+        for k, v in req.items():
+            avail[k] = avail.get(k, 0.0) + v
+
+    def _alive_nodes(self) -> List[str]:
+        return [n_id for n_id, n in self.nodes.items() if n.alive]
+
+    # ---------- placement groups ----------
+
+    def handle_create_placement_group(
+        self, bundles: List[Dict[str, float]], strategy: str
+    ) -> str:
+        """Reserve bundle resources per strategy. Parity: Ray placement groups as
+        used by the reference (context.py:94-113, mpi_job.py:192-222)."""
+        strategy = strategy.upper()
+        if strategy not in ("PACK", "STRICT_PACK", "SPREAD", "STRICT_SPREAD"):
+            raise ClusterError(f"unknown placement strategy {strategy}")
+        with self.lock:
+            pg = _PlacementGroup(f"pg-{uuid.uuid4().hex[:8]}", bundles, strategy)
+            placed: List[tuple] = []  # (bundle, node_id) for rollback
+
+            def place(bundle: _Bundle, node_id: str) -> None:
+                self._sub(self.node_available[node_id], bundle.resources)
+                bundle.node_id = node_id
+                placed.append((bundle, node_id))
+
+            def rollback() -> None:
+                for bundle, node_id in placed:
+                    self._add(self.node_available[node_id], bundle.resources)
+
+            try:
+                if strategy == "STRICT_PACK":
+                    for node_id in self._alive_nodes():
+                        avail = dict(self.node_available[node_id])
+                        ok = True
+                        for b in pg.bundles:
+                            if not self._fits(avail, b.resources):
+                                ok = False
+                                break
+                            self._sub(avail, b.resources)
+                        if ok:
+                            for b in pg.bundles:
+                                place(b, node_id)
+                            break
+                    else:
+                        raise ClusterError("STRICT_PACK: no single node fits all bundles")
+                elif strategy == "STRICT_SPREAD":
+                    used: set = set()
+                    for b in pg.bundles:
+                        for node_id in self._alive_nodes():
+                            if node_id not in used and self._fits(
+                                self.node_available[node_id], b.resources
+                            ):
+                                place(b, node_id)
+                                used.add(node_id)
+                                break
+                        else:
+                            raise ClusterError(
+                                "STRICT_SPREAD: not enough distinct nodes with capacity"
+                            )
+                else:  # PACK / SPREAD: best effort orderings
+                    node_order = self._alive_nodes()
+                    for b in pg.bundles:
+                        candidates = [
+                            n for n in node_order if self._fits(self.node_available[n], b.resources)
+                        ]
+                        if not candidates:
+                            raise ClusterError("placement group does not fit cluster")
+                        if strategy == "SPREAD":
+                            counts = {n: 0 for n in node_order}
+                            for pb, pn in placed:
+                                if pn in counts:
+                                    counts[pn] += 1
+                            candidates.sort(key=lambda n: counts[n])
+                        place(b, candidates[0])
+            except Exception:
+                rollback()
+                raise
+            self.pgs[pg.pg_id] = pg
+            return pg.pg_id
+
+    def handle_remove_placement_group(self, pg_id: str):
+        with self.lock:
+            pg = self.pgs.pop(pg_id, None)
+            if pg is None:
+                return False
+            for b in pg.bundles:
+                if b.node_id is not None and self.nodes[b.node_id].alive:
+                    # return whatever of the reservation is still unconsumed
+                    self._add(self.node_available[b.node_id], b.remaining)
+            return True
+
+    def handle_placement_group_table(self):
+        with self.lock:
+            return {
+                pg_id: {
+                    "strategy": pg.strategy,
+                    "bundles": [
+                        {"index": b.index, "node_id": b.node_id, "resources": b.resources}
+                        for b in pg.bundles
+                    ],
+                }
+                for pg_id, pg in self.pgs.items()
+            }
+
+    def handle_pg_next_bundle(self, pg_id: str) -> int:
+        with self.lock:
+            pg = self.pgs[pg_id]
+            index = pg.next_bundle % len(pg.bundles)
+            pg.next_bundle += 1
+            return index
+
+    # ---------- actors ----------
+
+    def _schedule(self, actor: _Actor) -> str:
+        """Pick a node for the actor and charge resources; raises if nothing fits.
+        Records which bundle was charged so death can credit the same bundle."""
+        spec = actor.spec
+        if spec.placement_group is not None:
+            pg = self.pgs.get(spec.placement_group)
+            if pg is None:
+                raise ClusterError(f"placement group {spec.placement_group} not found")
+            index = spec.bundle_index
+            if index < 0:
+                index = pg.next_bundle % len(pg.bundles)
+            bundle = pg.bundles[index]
+            if bundle.node_id is None or not self.nodes[bundle.node_id].alive:
+                raise ClusterError("placement bundle's node is gone")
+            if not self._fits(bundle.remaining, spec.resources):
+                raise ClusterError(
+                    f"bundle {index} of {pg.pg_id} lacks {spec.resources}, has {bundle.remaining}"
+                )
+            self._sub(bundle.remaining, spec.resources)
+            if spec.bundle_index < 0:
+                pg.next_bundle += 1  # advance round-robin only on success
+            actor.scheduled_bundle = index
+            return bundle.node_id
+        for node_id in self._alive_nodes():
+            if self._fits(self.node_available[node_id], spec.resources):
+                self._sub(self.node_available[node_id], spec.resources)
+                actor.scheduled_bundle = -1
+                return node_id
+        raise ClusterError(
+            f"no node can host actor {spec.name or spec.actor_id} "
+            f"requiring {spec.resources}; available={self.handle_available_resources()}"
+        )
+
+    def _spawn(self, actor: _Actor) -> None:
+        spec = actor.spec
+        node = self.nodes[actor.node_id]
+        log_base = os.path.join(
+            self.session_dir, f"a-{spec.actor_id}-{actor.incarnation}"
+        )
+        env = dict(os.environ)
+        env.update(spec.env)
+        env[SESSION_ENV] = self.session_dir
+        env["RAYDP_TPU_ACTOR_ID"] = spec.actor_id
+        env["RAYDP_TPU_NODE_ID"] = actor.node_id
+        env["RAYDP_TPU_NODE_IP"] = node.node_ip
+        with open(log_base + ".out", "ab") as out, open(log_base + ".err", "ab") as err:
+            actor.proc = subprocess.Popen(
+                [
+                    sys.executable,
+                    "-m",
+                    "raydp_tpu.cluster.worker",
+                    self.session_dir,
+                    spec.actor_id,
+                    str(actor.incarnation),
+                ],
+                stdout=out,
+                stderr=err,
+                env=env,
+                start_new_session=True,
+            )
+
+    def handle_create_actor(self, spec: ActorSpec) -> str:
+        with self.lock:
+            if spec.name is not None and spec.name in self.named:
+                raise ClusterError(f"actor name {spec.name!r} already taken")
+            actor = _Actor(spec)
+            actor.node_id = self._schedule(actor)
+            try:
+                spec_path = os.path.join(self.session_dir, f"a-{spec.actor_id}.spec")
+                with open(spec_path, "wb") as f:
+                    import cloudpickle
+
+                    cloudpickle.dump(spec, f)
+                self.actors[spec.actor_id] = actor
+                if spec.name is not None:
+                    self.named[spec.name] = spec.actor_id
+                self._spawn(actor)
+            except BaseException:
+                # roll back so a failed spawn doesn't leak resources or the name
+                self._release_actor_resources(actor)
+                self.actors.pop(spec.actor_id, None)
+                if spec.name is not None and self.named.get(spec.name) == spec.actor_id:
+                    del self.named[spec.name]
+                raise
+            return spec.actor_id
+
+    def handle_actor_ready(self, actor_id: str, incarnation: int, sock_path: str):
+        with self.lock:
+            actor = self.actors[actor_id]
+            if incarnation != actor.incarnation:
+                return False  # stale incarnation raced with a respawn
+            actor.sock_path = sock_path
+            actor.state = ActorState.ALIVE
+            return True
+
+    def handle_actor_init_failed(self, actor_id: str, incarnation: int, error: str):
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is not None and incarnation == actor.incarnation:
+                actor.error = error
+                actor.intentional_exit = True  # init failure: don't retry-loop
+            return True
+
+    def handle_get_actor(self, actor_id: Optional[str] = None, name: Optional[str] = None):
+        with self.lock:
+            if actor_id is None:
+                if name is None or name not in self.named:
+                    return None
+                actor_id = self.named[name]
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return None
+            ip = self.nodes[actor.node_id].node_ip if actor.node_id else None
+            return actor.record(ip)
+
+    def handle_list_actors(self):
+        with self.lock:
+            return [
+                a.record(self.nodes[a.node_id].node_ip if a.node_id else None)
+                for a in self.actors.values()
+            ]
+
+    def handle_mark_intentional_exit(self, actor_id: str):
+        """Called by an actor about to exit on purpose so the monitor does not
+        restart it (parity: Ray.exitActor used precisely for this,
+        reference ApplicationInfo.scala:119-124)."""
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is not None:
+                actor.intentional_exit = True
+            return True
+
+    def _kill_proc(self, actor: _Actor) -> None:
+        if actor.proc is not None and actor.proc.poll() is None:
+            try:
+                os.killpg(actor.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+
+    def handle_kill_actor(self, actor_id: str, no_restart: bool = True):
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                return False
+            if no_restart:
+                actor.intentional_exit = True
+            self._kill_proc(actor)
+            return True
+
+    def _release_actor_resources(self, actor: _Actor) -> None:
+        spec = actor.spec
+        if spec.placement_group is not None:
+            pg = self.pgs.get(spec.placement_group)
+            if pg is not None and 0 <= actor.scheduled_bundle < len(pg.bundles):
+                self._add(pg.bundles[actor.scheduled_bundle].remaining, spec.resources)
+            actor.scheduled_bundle = -1
+            return
+        if actor.node_id is not None and self.nodes[actor.node_id].alive:
+            self._add(self.node_available[actor.node_id], spec.resources)
+
+    def _on_actor_death(self, actor: _Actor) -> None:
+        """Monitor-thread callback when an actor process has exited."""
+        self._release_actor_resources(actor)
+        old_sock = actor.sock_path
+        actor.sock_path = None
+        if old_sock:
+            try:
+                os.unlink(old_sock)
+            except OSError:
+                pass
+        if actor.intentional_exit or actor.restarts_used >= actor.spec.max_restarts:
+            actor.state = ActorState.DEAD
+            self._on_owner_dead(actor.spec.actor_id)
+            if actor.spec.name is not None:
+                # keep the name → id mapping so get_actor(name) reports DEAD
+                pass
+            return
+        actor.restarts_used += 1
+        actor.incarnation += 1
+        actor.state = ActorState.RESTARTING
+        actor.pending_respawn = True
+        self._try_respawn(actor)
+
+    def _try_respawn(self, actor: _Actor) -> None:
+        try:
+            actor.node_id = self._schedule(actor)
+        except ClusterError:
+            return  # stays pending; retried by the monitor when capacity returns
+        actor.pending_respawn = False
+        try:
+            self._spawn(actor)
+        except OSError:
+            self._release_actor_resources(actor)
+            actor.pending_respawn = True
+
+    # ---------- object ownership table ----------
+
+    def handle_object_put(
+        self, object_id: str, owner: str, shm_name: str, size: int, node_id: str
+    ):
+        with self.lock:
+            self.objects[object_id] = _ObjectMeta(object_id, owner, shm_name, size, node_id)
+            return True
+
+    def handle_object_lookup(self, object_id: str):
+        with self.lock:
+            meta = self.objects.get(object_id)
+            if meta is None:
+                return None
+            if meta.owner_died:
+                raise OwnerDiedError(
+                    f"object {object_id}: owner died and the object was not "
+                    "transferred before the owner exited"
+                )
+            return {
+                "shm_name": meta.shm_name,
+                "size": meta.size,
+                "owner": meta.owner,
+                "node_id": meta.node_id,
+            }
+
+    def handle_object_transfer_owner(self, object_ids: List[str], new_owner: str):
+        """Ownership transfer: data outlives the engine that produced it
+        (parity: _use_owner path, reference dataset.py:157-171 +
+        ObjectStoreWriter.scala:70-79)."""
+        with self.lock:
+            for object_id in object_ids:
+                meta = self.objects.get(object_id)
+                if meta is not None and not meta.owner_died:
+                    meta.owner = new_owner
+            return True
+
+    def handle_object_delete(self, object_ids: List[str]):
+        with self.lock:
+            for object_id in object_ids:
+                meta = self.objects.pop(object_id, None)
+                if meta is not None:
+                    self._unlink_shm(meta.shm_name)
+            return True
+
+    def handle_object_owner_of(self, object_id: str):
+        with self.lock:
+            meta = self.objects.get(object_id)
+            return None if meta is None else meta.owner
+
+    @staticmethod
+    def _unlink_shm(shm_name: str) -> None:
+        try:
+            os.unlink(os.path.join("/dev/shm", shm_name.lstrip("/")))
+        except OSError:
+            pass
+
+    def _on_owner_dead(self, owner: str) -> None:
+        for meta in self.objects.values():
+            if meta.owner == owner and not meta.owner_died:
+                meta.owner_died = True
+                self._unlink_shm(meta.shm_name)
+
+    # ---------- lifecycle ----------
+
+    def handle_ping(self):
+        return "pong"
+
+    def handle_shutdown(self):
+        with self.lock:
+            self.shutting_down = True
+            for actor in self.actors.values():
+                actor.intentional_exit = True
+                self._kill_proc(actor)
+            for meta in self.objects.values():
+                self._unlink_shm(meta.shm_name)
+            self.objects.clear()
+        return True
+
+    def monitor_loop(self) -> None:
+        while not self.shutting_down:
+            time.sleep(0.05)
+            with self.lock:
+                for actor in list(self.actors.values()):
+                    if actor.state == ActorState.DEAD:
+                        continue
+                    if actor.pending_respawn:
+                        self._try_respawn(actor)
+                        continue
+                    if actor.proc is not None and actor.proc.poll() is not None:
+                        self._on_actor_death(actor)
+            # driver liveness: tear everything down if the driver is gone
+            if self.driver_pid and not _pid_alive(self.driver_pid):
+                self.handle_shutdown()
+                os._exit(0)
+
+
+def _pid_alive(pid: int) -> bool:
+    try:
+        os.kill(pid, 0)
+        return True
+    except ProcessLookupError:
+        return False
+    except PermissionError:
+        return True
+
+
+class _Handler(socketserver.BaseRequestHandler):
+    def handle(self):
+        head: Head = self.server.head  # type: ignore[attr-defined]
+        try:
+            method, kwargs = recv_frame(self.request)
+        except (ConnectionError, EOFError):
+            return
+        try:
+            fn = getattr(head, f"handle_{method}", None)
+            if fn is None:
+                raise ClusterError(f"unknown head method {method!r}")
+            result = fn(**kwargs)
+            reply = ("ok", result)
+        except BaseException as exc:  # noqa: BLE001 - propagate to caller
+            exc.__cause__ = None
+            reply = ("err", exc)
+        try:
+            send_frame(self.request, reply)
+        except (ConnectionError, BrokenPipeError):
+            pass
+
+
+class _Server(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, float]) -> None:
+    head = Head(session_dir, driver_pid, default_resources)
+    server = _Server(head_sock_path(session_dir), _Handler)
+    server.head = head  # type: ignore[attr-defined]
+    monitor = threading.Thread(target=head.monitor_loop, name="monitor", daemon=True)
+    monitor.start()
+    server.timeout = 0.2
+    try:
+        while not head.shutting_down:
+            server.handle_request()
+    finally:
+        server.server_close()

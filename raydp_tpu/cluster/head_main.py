@@ -1,0 +1,19 @@
+"""``python -m raydp_tpu.cluster.head_main <session_dir>`` — head process entry."""
+
+import os
+import sys
+
+import cloudpickle
+
+from raydp_tpu.cluster.head import run_head
+
+
+def main() -> None:
+    session_dir = sys.argv[1]
+    with open(os.path.join(session_dir, "head_boot.pkl"), "rb") as f:
+        driver_pid, default_resources = cloudpickle.load(f)
+    run_head(session_dir, driver_pid, default_resources)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,202 @@
+"""Actor process entry point.
+
+Hosts exactly one user actor object, serving method calls over a Unix socket
+with a bounded execution pool — the analog of the reference's Ray-actor-hosted
+executors (RayDPExecutor.scala:194-253). ``max_concurrency`` mirrors the
+reference's ``setMaxConcurrency(2)`` (RayExecutorUtils.java:65): an executor can
+serve data-plane reads while its main loop is busy.
+
+Deliberately light on imports so respawn after a crash is fast; user classes
+pull in heavy deps (pyarrow, jax) themselves.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import os
+import socket
+import socketserver
+import sys
+import threading
+import traceback
+
+import cloudpickle
+
+from raydp_tpu.cluster.common import (
+    actor_sock_path,
+    head_sock_path,
+    recv_frame,
+    rpc,
+    send_frame,
+)
+
+
+class _WorkerContext:
+    """Process-global context for code running inside this actor."""
+
+    def __init__(self, session_dir: str, actor_id: str, incarnation: int):
+        self.session_dir = session_dir
+        self.actor_id = actor_id
+        self.incarnation = incarnation
+        self.node_ip = os.environ.get("RAYDP_TPU_NODE_IP", "127.0.0.1")
+        self.node_id = os.environ.get("RAYDP_TPU_NODE_ID", "")
+
+
+_context: _WorkerContext | None = None
+
+
+def current_context() -> _WorkerContext | None:
+    return _context
+
+
+def exit_actor() -> None:
+    """Intentional exit: the head will NOT restart this actor (parity:
+    Ray.exitActor semantics relied on at reference ApplicationInfo.scala:119-124)."""
+    ctx = _context
+    if ctx is None:
+        raise RuntimeError("exit_actor() called outside an actor process")
+    try:
+        rpc(
+            head_sock_path(ctx.session_dir),
+            ("mark_intentional_exit", {"actor_id": ctx.actor_id}),
+            timeout=10,
+        )
+    finally:
+        os._exit(0)
+
+
+class _ActorServer(socketserver.ThreadingUnixStreamServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _serve(instance, sock_path: str, max_concurrency: int, stop_event: threading.Event):
+    pool = concurrent.futures.ThreadPoolExecutor(max_workers=max(1, max_concurrency))
+
+    class Handler(socketserver.BaseRequestHandler):
+        def handle(self):
+            try:
+                method, args, kwargs, no_reply = recv_frame(self.request)
+            except (ConnectionError, EOFError):
+                return
+            if method == "__ping__":
+                send_frame(self.request, ("ok", "pong"))
+                return
+            if method == "__shutdown__":
+                send_frame(self.request, ("ok", True))
+                stop_event.set()
+                return
+
+            def run():
+                try:
+                    fn = getattr(instance, method)
+                    return ("ok", fn(*args, **kwargs))
+                except BaseException as exc:  # noqa: BLE001
+                    tb = traceback.format_exc()
+                    try:
+                        cloudpickle.dumps(exc)
+                    except Exception:
+                        exc = RuntimeError(f"{type(exc).__name__}: {exc}")
+                    exc.remote_traceback = tb  # type: ignore[attr-defined]
+                    return ("err", exc)
+
+            future = pool.submit(run)
+            if no_reply:
+                return
+            reply = future.result()
+            try:
+                send_frame(self.request, reply)
+            except (ConnectionError, BrokenPipeError):
+                pass
+            except Exception as exc:  # unpicklable result: report, don't sever
+                try:
+                    send_frame(
+                        self.request,
+                        (
+                            "err",
+                            RuntimeError(
+                                f"result of {method}() could not be serialized: "
+                                f"{type(exc).__name__}: {exc}"
+                            ),
+                        ),
+                    )
+                except (ConnectionError, BrokenPipeError):
+                    pass
+
+    server = _ActorServer(sock_path, Handler)
+    server.timeout = 0.2
+    while not stop_event.is_set():
+        server.handle_request()
+    server.server_close()
+
+
+def main() -> None:
+    global _context
+    session_dir, actor_id, incarnation_str = sys.argv[1], sys.argv[2], sys.argv[3]
+    incarnation = int(incarnation_str)
+    _context = _WorkerContext(session_dir, actor_id, incarnation)
+    head = head_sock_path(session_dir)
+
+    spec_path = os.path.join(session_dir, f"a-{actor_id}.spec")
+    with open(spec_path, "rb") as f:
+        spec = cloudpickle.load(f)
+
+    try:
+        cls = cloudpickle.loads(spec.cls_blob)
+        args, kwargs = cloudpickle.loads(spec.args_blob)
+        instance = cls(*args, **kwargs)
+    except BaseException:  # noqa: BLE001 - report init failure then die
+        rpc(
+            head,
+            (
+                "actor_init_failed",
+                {
+                    "actor_id": actor_id,
+                    "incarnation": incarnation,
+                    "error": traceback.format_exc(),
+                },
+            ),
+            timeout=10,
+        )
+        raise
+
+    sock_path = actor_sock_path(session_dir, actor_id, incarnation)
+    try:
+        os.unlink(sock_path)
+    except OSError:
+        pass
+    stop_event = threading.Event()
+    server_thread = threading.Thread(
+        target=_serve,
+        args=(instance, sock_path, spec.max_concurrency, stop_event),
+        daemon=True,
+    )
+    server_thread.start()
+    # wait for the socket to be bound before reporting ready
+    import time
+
+    deadline = time.monotonic() + 10
+    while not os.path.exists(sock_path) and time.monotonic() < deadline:
+        time.sleep(0.005)
+    rpc(
+        head,
+        (
+            "actor_ready",
+            {"actor_id": actor_id, "incarnation": incarnation, "sock_path": sock_path},
+        ),
+        timeout=30,
+    )
+    stop_event.wait()
+    if hasattr(instance, "on_shutdown"):
+        try:
+            instance.on_shutdown()
+        except Exception:
+            traceback.print_exc()
+
+
+if __name__ == "__main__":
+    # run via the canonical module object so user code reaching
+    # raydp_tpu.cluster.worker sees the same process-global _context
+    from raydp_tpu.cluster import worker as _canonical
+
+    _canonical.main()

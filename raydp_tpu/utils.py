@@ -156,7 +156,8 @@ def divide_blocks(
     # Pad the index list cyclically so striping is even, then stripe.
     order = list(range(len(blocks)))
     order += order[: total_slots - len(order)]
-    rng = np.random.default_rng(0 if shuffle_seed is None else shuffle_seed)
+    # unseeded shuffle must actually vary between calls (epochs)
+    rng = np.random.default_rng(shuffle_seed)
     if shuffle:
         rng.shuffle(order)
 
