@@ -48,8 +48,19 @@ def is_initialized() -> bool:
     return _session_dir is not None
 
 
+def _join_from_env() -> Optional[str]:
+    """Adopt the session an enclosing actor was spawned into, if any."""
+    global _session_dir
+    with _lock:
+        if _session_dir is None:
+            env_session = os.environ.get(SESSION_ENV)
+            if env_session:
+                _session_dir = env_session
+        return _session_dir
+
+
 def session_dir() -> str:
-    if _session_dir is None:
+    if _session_dir is None and _join_from_env() is None:
         raise ClusterError("cluster runtime not initialized; call cluster.init()")
     return _session_dir
 
@@ -69,11 +80,7 @@ def init(
     cluster they were spawned into."""
     global _session_dir, _head_proc
     with _lock:
-        if _session_dir is not None:
-            return _session_dir
-        env_session = os.environ.get(SESSION_ENV)
-        if env_session:
-            _session_dir = env_session
+        if _session_dir is not None or _join_from_env() is not None:
             return _session_dir
         root = session_root or os.path.join(tempfile.gettempdir(), "raydp_tpu")
         os.makedirs(root, exist_ok=True)

@@ -1,0 +1,8 @@
+#!/bin/sh
+# Build the native store library. Idempotent and concurrency-safe: compile to a
+# temp file, atomically rename into place.
+set -e
+cd "$(dirname "$0")"
+tmp="libraydp_store.so.tmp.$$"
+g++ -O2 -fPIC -shared -std=c++17 -o "$tmp" store.cpp
+mv -f "$tmp" libraydp_store.so

@@ -1,0 +1,336 @@
+"""Object store: shared-memory blocks with ownership semantics.
+
+The exchange currency of the framework is the Arrow IPC stream block, exactly
+as in the reference (SURVEY.md L5; wire format at ObjectStoreWriter.scala:55-85)
+— but the store is native /dev/shm segments (C++, see native/store.cpp) instead
+of Ray's plasma, and ownership lives in the head process:
+
+- every object has an *owner* (an actor id, or the driver); when the owner
+  dies, un-transferred objects are GC'd and reads raise ``OwnerDiedError``
+  (parity: test_fail_without_data_ownership_transfer,
+  reference test_data_owner_transfer.py:33-77);
+- ``transfer()`` re-assigns ownership (to e.g. a long-lived holder actor) so
+  data outlives the ETL engine that produced it (parity: _use_owner path,
+  reference dataset.py:157-171, ObjectStoreWriter.scala:64-85).
+
+Reads are zero-copy: the mapped segment is exposed to pyarrow as a foreign
+buffer feeding ``ipc.open_stream`` directly.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+import uuid
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+from raydp_tpu.cluster import api as cluster_api
+from raydp_tpu.cluster.common import DRIVER_OWNER, ClusterError
+
+_NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libraydp_store.so")
+_lib_lock = threading.Lock()
+_lib: Optional[ctypes.CDLL] = None
+
+
+def _load_native() -> ctypes.CDLL:
+    """Load (building if needed) the native store library. Cross-process safe:
+    the build is guarded by an flock and renames atomically into place."""
+    global _lib
+    with _lib_lock:
+        if _lib is not None:
+            return _lib
+        if not os.path.exists(_LIB_PATH):
+            import fcntl
+
+            lock_path = os.path.join(_NATIVE_DIR, ".build.lock")
+            with open(lock_path, "w") as lock_file:
+                fcntl.flock(lock_file, fcntl.LOCK_EX)
+                if not os.path.exists(_LIB_PATH):
+                    subprocess.run(
+                        ["sh", os.path.join(_NATIVE_DIR, "build.sh")],
+                        check=True,
+                        capture_output=True,
+                    )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.rtpu_shm_create.restype = ctypes.c_void_p
+        lib.rtpu_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_shm_finalize.restype = ctypes.c_int
+        lib.rtpu_shm_finalize.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
+        lib.rtpu_shm_map.restype = ctypes.c_void_p
+        lib.rtpu_shm_map.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int,
+        ]
+        lib.rtpu_shm_unmap.restype = ctypes.c_int
+        lib.rtpu_shm_unmap.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.rtpu_shm_unlink.restype = ctypes.c_int
+        lib.rtpu_shm_unlink.argtypes = [ctypes.c_char_p]
+        lib.rtpu_shm_put.restype = ctypes.c_int
+        lib.rtpu_shm_put.argtypes = [ctypes.c_char_p, ctypes.c_void_p, ctypes.c_uint64]
+        lib.rtpu_errno.restype = ctypes.c_int
+        _lib = lib
+        return lib
+
+
+def current_owner() -> str:
+    """The owner id for objects created by this process: the enclosing actor,
+    or the driver sentinel."""
+    from raydp_tpu.cluster.worker import current_context
+
+    ctx = current_context()
+    return ctx.actor_id if ctx is not None else DRIVER_OWNER
+
+
+@dataclass(frozen=True)
+class ObjectRef:
+    """Picklable reference to one stored block."""
+
+    object_id: str
+    size: int
+
+    @property
+    def shm_name(self) -> str:
+        return f"/rtpu-{self.object_id}"
+
+
+class _MappedBuffer:
+    """Owns an mmap of a segment; keeps it alive for zero-copy consumers.
+    ``size`` is the logical object size; ``mapped_size`` the mapping length."""
+
+    def __init__(self, lib: ctypes.CDLL, ptr: int, size: int, mapped_size: Optional[int] = None):
+        self._lib = lib
+        self.ptr = ptr
+        self.size = size
+        self.mapped_size = size if mapped_size is None else mapped_size
+
+    def memoryview(self) -> memoryview:
+        if self.size == 0:
+            return memoryview(b"")
+        # route through an arrow foreign buffer so the returned view keeps this
+        # mapping alive (ctypes.from_address would dangle after GC → segfault)
+        import pyarrow as pa
+
+        return memoryview(pa.foreign_buffer(self.ptr, self.size, base=self))
+
+    def __del__(self):
+        try:
+            if self.ptr:
+                self._lib.rtpu_shm_unmap(ctypes.c_void_p(self.ptr), self.mapped_size)
+        except Exception:
+            pass
+
+
+class WritableBlock:
+    """A created-but-unsealed segment writers stream Arrow IPC into directly
+    (no staging copy): ``block = create_block(cap); sink = block.arrow_sink();
+    ... ; ref = block.seal(owner)``."""
+
+    def __init__(self, object_id: str, capacity: int):
+        import mmap as _mmap
+
+        self.object_id = object_id
+        self.capacity = capacity
+        self._lib = _load_native()
+        self._name = f"/rtpu-{object_id}".encode()
+        ptr = self._lib.rtpu_shm_create(self._name, capacity)
+        if not ptr:
+            raise OSError(
+                f"shm create failed (errno={self._lib.rtpu_errno()}) for {capacity} bytes"
+            )
+        # drop the C++ mapping; writers need a *writable* python-buffer view,
+        # which pyarrow only honors through the buffer protocol (mmap)
+        self._lib.rtpu_shm_unmap(ctypes.c_void_p(ptr), capacity)
+        self._file = open("/dev/shm" + self._name.decode(), "r+b")
+        self._mmap = _mmap.mmap(self._file.fileno(), capacity)
+        self._sealed = False
+
+    def arrow_sink(self):
+        """A pyarrow FixedSizeBufferWriter over the raw segment (writes stream
+        straight into shared memory; no staging copy)."""
+        import pyarrow as pa
+
+        return pa.FixedSizeBufferWriter(pa.py_buffer(self._mmap))
+
+    def _close_mapping(self) -> None:
+        try:
+            self._mmap.close()
+        except BufferError:
+            pass  # an arrow sink still holds the buffer; kernel keeps the pages
+        self._file.close()
+
+    def seal(self, written: int, owner: Optional[str] = None) -> ObjectRef:
+        if self._sealed:
+            raise ClusterError("block already sealed")
+        if written > self.capacity:
+            raise ClusterError(f"wrote {written} past capacity {self.capacity}")
+        self._close_mapping()
+        if written < self.capacity:
+            if self._lib.rtpu_shm_finalize(self._name, written) != 0:
+                err = self._lib.rtpu_errno()
+                self._lib.rtpu_shm_unlink(self._name)
+                self._sealed = True
+                raise OSError(f"shm finalize failed (errno={err})")
+        ref = ObjectRef(self.object_id, written)
+        try:
+            _register(ref, owner)
+        except BaseException:
+            self._lib.rtpu_shm_unlink(self._name)
+            self._sealed = True
+            raise
+        self._sealed = True
+        return ref
+
+    def abort(self) -> None:
+        if not self._sealed:
+            self._close_mapping()
+            self._lib.rtpu_shm_unlink(self._name)
+            self._sealed = True
+
+
+def _register(ref: ObjectRef, owner: Optional[str]) -> None:
+    from raydp_tpu.cluster.worker import current_context
+
+    ctx = current_context()
+    cluster_api.head_rpc(
+        "object_put",
+        object_id=ref.object_id,
+        owner=owner or current_owner(),
+        shm_name=ref.shm_name,
+        size=ref.size,
+        node_id=ctx.node_id if ctx else "driver",
+    )
+
+
+def new_object_id() -> str:
+    return uuid.uuid4().hex[:16]
+
+
+def create_block(capacity: int) -> WritableBlock:
+    return WritableBlock(new_object_id(), capacity)
+
+
+def put(data, owner: Optional[str] = None) -> ObjectRef:
+    """Store a materialized buffer (bytes / memoryview / arrow Buffer)."""
+    import pyarrow as pa
+
+    buf = data if isinstance(data, pa.Buffer) else pa.py_buffer(data)
+    lib = _load_native()
+    object_id = new_object_id()
+    ref = ObjectRef(object_id, buf.size)
+    rc = lib.rtpu_shm_put(
+        ref.shm_name.encode(), ctypes.c_void_p(buf.address), buf.size
+    )
+    if rc != 0:
+        raise OSError(f"shm put failed (errno={lib.rtpu_errno()})")
+    try:
+        _register(ref, owner)
+    except BaseException:
+        lib.rtpu_shm_unlink(ref.shm_name.encode())
+        raise
+    return ref
+
+
+def _lookup(ref: ObjectRef) -> dict:
+    meta = cluster_api.head_rpc("object_lookup", object_id=ref.object_id)
+    if meta is None:
+        raise ClusterError(f"object {ref.object_id} not found (already deleted?)")
+    return meta
+
+
+def get_buffer(ref: ObjectRef) -> _MappedBuffer:
+    """Zero-copy mapped view of the object (raises OwnerDiedError via head if
+    the owner died untransferred). The registered size is authoritative — the
+    segment may be 1 byte for empty objects or capacity-sized if finalize was
+    skipped."""
+    meta = _lookup(ref)
+    lib = _load_native()
+    if meta["size"] == 0:
+        return _MappedBuffer(lib, 0, 0)
+    seg_size = ctypes.c_uint64()
+    ptr = lib.rtpu_shm_map(ref.shm_name.encode(), ctypes.byref(seg_size), 0)
+    if not ptr:
+        raise ClusterError(
+            f"object {ref.object_id} metadata exists but segment is gone"
+        )
+    if seg_size.value < meta["size"]:
+        lib.rtpu_shm_unmap(ctypes.c_void_p(ptr), seg_size.value)
+        raise ClusterError(
+            f"object {ref.object_id} segment truncated: "
+            f"{seg_size.value} < {meta['size']}"
+        )
+    return _MappedBuffer(lib, ptr, meta["size"], mapped_size=seg_size.value)
+
+
+def get_bytes(ref: ObjectRef) -> bytes:
+    return bytes(get_buffer(ref).memoryview())
+
+
+def get_arrow_buffer(ref: ObjectRef):
+    """The object as a pyarrow Buffer backed by the shared mapping (zero-copy)."""
+    import pyarrow as pa
+
+    mapped = get_buffer(ref)
+    if mapped.size == 0:
+        return pa.py_buffer(b"")
+    return pa.foreign_buffer(mapped.ptr, mapped.size, base=mapped)
+
+
+def read_arrow_batches(ref: ObjectRef):
+    """Decode an Arrow-IPC-stream object into (schema, [RecordBatch...])."""
+    import pyarrow as pa
+
+    with pa.ipc.open_stream(get_arrow_buffer(ref)) as reader:
+        schema = reader.schema
+        batches = list(reader)
+    return schema, batches
+
+
+def transfer(refs: Sequence[ObjectRef], new_owner: str) -> None:
+    """Re-own objects (e.g. to a long-lived holder actor) so they survive their
+    producer's death."""
+    cluster_api.head_rpc(
+        "object_transfer_owner",
+        object_ids=[r.object_id for r in refs],
+        new_owner=new_owner,
+    )
+
+
+def delete(refs: Sequence[ObjectRef]) -> None:
+    cluster_api.head_rpc("object_delete", object_ids=[r.object_id for r in refs])
+
+
+def owner_of(ref: ObjectRef) -> Optional[str]:
+    return cluster_api.head_rpc("object_owner_of", object_id=ref.object_id)
+
+
+class ObjectHolder:
+    """Long-lived actor pinning ObjectRefs per dataset uuid — the ownership-
+    transfer target. Parity: RayDPSparkMaster.add_objects/get_object
+    (reference ray_cluster_master.py:187-191)."""
+
+    def __init__(self):
+        self._objects = {}
+
+    def add_objects(self, dataset_uuid: str, refs: List[ObjectRef]) -> int:
+        self._objects[dataset_uuid] = list(refs)
+        transfer(refs, current_owner())
+        return len(refs)
+
+    def get_objects(self, dataset_uuid: str) -> Optional[List[ObjectRef]]:
+        return self._objects.get(dataset_uuid)
+
+    def get_object(self, dataset_uuid: str, index: int) -> ObjectRef:
+        return self._objects[dataset_uuid][index]
+
+    def remove_objects(self, dataset_uuid: str, delete_data: bool = True) -> bool:
+        refs = self._objects.pop(dataset_uuid, None)
+        if refs is None:
+            return False
+        if delete_data:
+            delete(refs)
+        return True

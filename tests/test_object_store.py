@@ -1,0 +1,150 @@
+"""Object store tests: zero-copy round trips, Arrow streaming, ownership.
+
+Parity targets: round-trip conversion equality (reference
+test_spark_cluster.py:96-124) at the block level, and the ownership-transfer
+semantics of test_data_owner_transfer.py:33-123 (OwnerDiedError without
+transfer; survival with transfer to a long-lived holder).
+"""
+
+import os
+import time
+
+import pyarrow as pa
+import pytest
+
+from raydp_tpu import cluster
+from raydp_tpu import store
+from raydp_tpu.cluster import ClusterError, OwnerDiedError
+
+
+@pytest.fixture(scope="module")
+def runtime():
+    cluster.init(num_cpus=8, memory=2 << 30)
+    yield
+    cluster.shutdown()
+
+
+def _make_table(n=100, seed=0):
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    return pa.table(
+        {
+            "x": rng.normal(size=n),
+            "y": rng.integers(0, 10, size=n),
+            "label": rng.normal(size=n).astype("float32"),
+        }
+    )
+
+
+def _write_table_block(table, owner=None):
+    est = sum(b.get_total_buffer_size() for b in table.to_batches()) + 4096
+    block = store.create_block(est)
+    sink = block.arrow_sink()
+    with pa.ipc.new_stream(sink, table.schema) as writer:
+        for batch in table.to_batches():
+            writer.write_batch(batch)
+    written = sink.tell()
+    return block.seal(written, owner=owner)
+
+
+def test_put_get_bytes_roundtrip(runtime):
+    payload = os.urandom(1 << 20)
+    ref = store.put(payload)
+    assert ref.size == len(payload)
+    assert store.get_bytes(ref) == payload
+    store.delete([ref])
+    with pytest.raises(ClusterError, match="not found"):
+        store.get_bytes(ref)
+
+
+def test_arrow_stream_block_roundtrip(runtime):
+    table = _make_table(1000)
+    ref = _write_table_block(table)
+    schema, batches = store.read_arrow_batches(ref)
+    out = pa.Table.from_batches(batches, schema)
+    assert out.equals(table)
+    store.delete([ref])
+
+
+def test_block_overcapacity_rejected(runtime):
+    block = store.create_block(64)
+    with pytest.raises(ClusterError, match="past capacity"):
+        block.seal(128)
+    block.abort()
+
+
+def test_ref_is_picklable_and_cross_process(runtime):
+    table = _make_table(50, seed=3)
+    ref = _write_table_block(table)
+
+    class Reader:
+        def total(self, r):
+            _, batches = store.read_arrow_batches(r)
+            return sum(b.num_rows for b in batches)
+
+    reader = cluster.spawn(Reader)
+    assert reader.total.remote(ref).result() == 50
+    reader.kill()
+    store.delete([ref])
+
+
+class Producer:
+    """Actor that writes blocks it owns (analog of a Spark executor writing
+    conversion output)."""
+
+    def produce(self, n):
+        table = _make_table(n, seed=7)
+        est = sum(b.get_total_buffer_size() for b in table.to_batches()) + 4096
+        block = store.create_block(est)
+        sink = block.arrow_sink()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            for batch in table.to_batches():
+                writer.write_batch(batch)
+        return block.seal(sink.tell())
+
+    def leave(self):
+        cluster.exit_actor()
+
+
+def _wait_dead(handle, timeout=15):
+    deadline = time.monotonic() + timeout
+    while handle.state() != cluster.ActorState.DEAD:
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+
+
+def test_owner_death_without_transfer_loses_data(runtime):
+    producer = cluster.spawn(Producer)
+    ref = producer.produce.remote(20).result()
+    assert store.get_bytes(ref)  # readable while owner lives
+    try:
+        producer.leave.remote().result()
+    except (ConnectionError, OSError, ClusterError):
+        pass
+    _wait_dead(producer)
+    with pytest.raises(OwnerDiedError):
+        store.get_bytes(ref)
+    # payload actually gone from /dev/shm, not just metadata
+    assert not os.path.exists("/dev/shm" + ref.shm_name)
+
+
+def test_ownership_transfer_to_holder_survives_producer(runtime):
+    holder = cluster.spawn(store.ObjectHolder, name="holder-test")
+    producer = cluster.spawn(Producer)
+    ref = producer.produce.remote(30).result()
+    holder.add_objects.remote("ds-1", [ref]).result()
+    assert store.owner_of(ref) == holder.actor_id
+    try:
+        producer.leave.remote().result()
+    except (ConnectionError, OSError, ClusterError):
+        pass
+    _wait_dead(producer)
+    # data survives: owner is now the holder
+    schema, batches = store.read_arrow_batches(ref)
+    assert sum(b.num_rows for b in batches) == 30
+    # holder cleanup removes payloads
+    holder.remove_objects.remote("ds-1").result()
+    with pytest.raises(ClusterError, match="not found"):
+        store.get_bytes(ref)
+    holder.kill()
