@@ -1,0 +1,32 @@
+"""Arrow-native distributed ETL engine on the cluster actor runtime.
+
+Replaces the reference's Spark-on-Ray stack (SURVEY.md L3/L4: JVM AppMaster,
+RayDPExecutor actors, py4j gateway) with an all-Python-and-Arrow engine: lazy
+DataFrames compile to fused per-partition pipelines scheduled onto restartable
+executor actors; shuffles ride the shared-memory object store.
+"""
+
+from raydp_tpu.etl import functions
+from raydp_tpu.etl.dataframe import DataFrame, GroupedData
+from raydp_tpu.etl.expressions import AggExpr, Expr
+from raydp_tpu.etl.functions import col, lit
+from raydp_tpu.etl.session import (
+    EtlSession,
+    active_session,
+    init_etl,
+    stop_etl,
+)
+
+__all__ = [
+    "AggExpr",
+    "DataFrame",
+    "EtlSession",
+    "Expr",
+    "GroupedData",
+    "active_session",
+    "col",
+    "functions",
+    "init_etl",
+    "lit",
+    "stop_etl",
+]

@@ -1,0 +1,49 @@
+"""ETL executor actor.
+
+The analog of the reference's RayDPExecutor (a Ray actor hosting a Spark
+executor, RayDPExecutor.scala:194-253): a restartable actor on the cluster
+runtime that runs partition tasks (tasks.py) and serves data-plane reads
+concurrently (max_concurrency > 1, mirroring setMaxConcurrency(2) at
+RayExecutorUtils.java:65). Blocks it produces are owned by it in the object
+store, so data dies with the ETL session unless ownership was transferred —
+the reference's exact GC semantics (SURVEY.md §3.2, test_data_owner_transfer).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import List, Optional
+
+from raydp_tpu.etl import tasks as T
+
+
+class EtlExecutor:
+    def __init__(self, executor_id: int, app_name: str, configs: Optional[dict] = None):
+        self.executor_id = executor_id
+        self.app_name = app_name
+        self.configs = dict(configs or {})
+        # keep BLAS/arrow thread pools from oversubscribing the host: each
+        # executor is sized by its CPU resource, not the whole machine
+        os.environ.setdefault("OMP_NUM_THREADS", "1")
+        os.environ.setdefault("ARROW_DEFAULT_THREADS", "1")
+
+    def ping(self) -> int:
+        return self.executor_id
+
+    def run_task(self, spec: T.TaskSpec) -> T.TaskResult:
+        return T.run_task(spec)
+
+    def run_tasks(self, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
+        return [T.run_task(s) for s in specs]
+
+    # -- data plane (exchange layer reads, SURVEY.md §3.6 analog) --
+
+    def get_block_ipc(self, ref) -> bytes:
+        """Materialize a block as IPC bytes (for cross-node pulls; local
+        readers map shared memory directly instead)."""
+        return T.table_to_ipc_bytes(T.read_table_block(ref))
+
+    def recompute_block(self, spec: T.TaskSpec) -> T.TaskResult:
+        """Recoverable-conversion hook: re-run the producing task (parity:
+        RecacheRDD re-materialization, reference RayDPDriverAgent.scala:59-71)."""
+        return T.run_task(spec)

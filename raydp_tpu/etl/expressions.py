@@ -1,0 +1,431 @@
+"""Column expression language for the ETL engine.
+
+The reference's ETL surface is Spark SQL (DataFrames executed by the JVM,
+SURVEY.md L3); this framework's ETL engine is Arrow-native, so expressions are
+a small picklable AST compiled to ``pyarrow.compute`` calls that run vectorized
+on each partition. Covers the expression shapes the reference's examples and
+tests actually exercise (projections, arithmetic, comparisons, casts, boolean
+logic, null handling, string/time functions — e.g. the NYCTaxi feature
+engineering in examples/data_process.py and the DLRM preprocessing notebook).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+# ---------------------------------------------------------------------------
+# AST nodes. All picklable (plain dataclasses) so plans ship to executors.
+# ---------------------------------------------------------------------------
+
+
+class Expr:
+    """Base class for expression nodes; evaluates against a RecordBatch/Table."""
+
+    def evaluate(self, table: pa.Table) -> pa.ChunkedArray:
+        raise NotImplementedError
+
+    def name_hint(self) -> str:
+        return "expr"
+
+    def references(self) -> List[str]:
+        """Column names this expression reads (for projection pushdown)."""
+        return []
+
+    # -- operator sugar (mirrors the pyspark Column operator surface) --
+
+    def _bin(self, op: str, other) -> "Expr":
+        return BinaryOp(op, self, _to_expr(other))
+
+    def _rbin(self, op: str, other) -> "Expr":
+        return BinaryOp(op, _to_expr(other), self)
+
+    def __add__(self, o):
+        return self._bin("add", o)
+
+    def __radd__(self, o):
+        return self._rbin("add", o)
+
+    def __sub__(self, o):
+        return self._bin("subtract", o)
+
+    def __rsub__(self, o):
+        return self._rbin("subtract", o)
+
+    def __mul__(self, o):
+        return self._bin("multiply", o)
+
+    def __rmul__(self, o):
+        return self._rbin("multiply", o)
+
+    def __truediv__(self, o):
+        return self._bin("divide", o)
+
+    def __rtruediv__(self, o):
+        return self._rbin("divide", o)
+
+    def __mod__(self, o):
+        return self._bin("mod", o)
+
+    def __eq__(self, o):  # type: ignore[override]
+        return self._bin("equal", o)
+
+    def __ne__(self, o):  # type: ignore[override]
+        return self._bin("not_equal", o)
+
+    def __lt__(self, o):
+        return self._bin("less", o)
+
+    def __le__(self, o):
+        return self._bin("less_equal", o)
+
+    def __gt__(self, o):
+        return self._bin("greater", o)
+
+    def __ge__(self, o):
+        return self._bin("greater_equal", o)
+
+    def __and__(self, o):
+        return self._bin("and_kleene", o)
+
+    def __rand__(self, o):
+        return self._rbin("and_kleene", o)
+
+    def __or__(self, o):
+        return self._bin("or_kleene", o)
+
+    def __ror__(self, o):
+        return self._rbin("or_kleene", o)
+
+    def __invert__(self):
+        return UnaryOp("invert", self)
+
+    def __neg__(self):
+        return UnaryOp("negate", self)
+
+    def __hash__(self):  # __eq__ is overloaded; keep Exprs usable in sets
+        return id(self)
+
+    # -- named methods --
+
+    def alias(self, name: str) -> "Expr":
+        return Alias(self, name)
+
+    def cast(self, dtype) -> "Expr":
+        return Cast(self, dtype)
+
+    def astype(self, dtype) -> "Expr":
+        return Cast(self, dtype)
+
+    def is_null(self) -> "Expr":
+        return UnaryOp("is_null", self)
+
+    def is_not_null(self) -> "Expr":
+        return UnaryOp("is_valid", self)
+
+    # pyspark-style names
+    def isNull(self) -> "Expr":
+        return self.is_null()
+
+    def isNotNull(self) -> "Expr":
+        return self.is_not_null()
+
+    def isin(self, *values) -> "Expr":
+        if len(values) == 1 and isinstance(values[0], (list, tuple, set)):
+            values = tuple(values[0])
+        return IsIn(self, list(values))
+
+    def between(self, low, high) -> "Expr":
+        return (self >= low) & (self <= high)
+
+    def fill_null(self, value) -> "Expr":
+        return Function("coalesce", [self, _to_expr(value)])
+
+    def substr(self, start: int, length: int) -> "Expr":
+        """1-based start (Spark convention), mapped to 0-based arrow slice."""
+        return Function(
+            "utf8_slice_codeunits",
+            [self],
+            options={"start": start - 1, "stop": start - 1 + length},
+        )
+
+
+def _to_expr(value) -> Expr:
+    return value if isinstance(value, Expr) else Literal(value)
+
+
+@dataclass(eq=False)
+class ColumnRef(Expr):
+    name: str
+
+    def evaluate(self, table: pa.Table):
+        try:
+            return table.column(self.name)
+        except KeyError:
+            raise KeyError(
+                f"column {self.name!r} not found; available: {table.column_names}"
+            ) from None
+
+    def name_hint(self) -> str:
+        return self.name
+
+    def references(self) -> List[str]:
+        return [self.name]
+
+
+@dataclass(eq=False)
+class Literal(Expr):
+    value: Any
+
+    def evaluate(self, table: pa.Table):
+        return pa.scalar(self.value)
+
+    def name_hint(self) -> str:
+        return str(self.value)
+
+
+@dataclass(eq=False)
+class Alias(Expr):
+    child: Expr
+    name: str
+
+    def evaluate(self, table: pa.Table):
+        return self.child.evaluate(table)
+
+    def name_hint(self) -> str:
+        return self.name
+
+    def references(self) -> List[str]:
+        return self.child.references()
+
+
+@dataclass(eq=False)
+class Cast(Expr):
+    child: Expr
+    dtype: Any  # pa.DataType or string name
+
+    def evaluate(self, table: pa.Table):
+        target = _resolve_dtype(self.dtype)
+        return pc.cast(self.child.evaluate(table), target, safe=False)
+
+    def name_hint(self) -> str:
+        return self.child.name_hint()
+
+    def references(self) -> List[str]:
+        return self.child.references()
+
+
+_DTYPE_ALIASES = {
+    "int": pa.int64(),
+    "long": pa.int64(),
+    "bigint": pa.int64(),
+    "int32": pa.int32(),
+    "int64": pa.int64(),
+    "float": pa.float32(),
+    "float32": pa.float32(),
+    "double": pa.float64(),
+    "float64": pa.float64(),
+    "bool": pa.bool_(),
+    "boolean": pa.bool_(),
+    "string": pa.string(),
+    "str": pa.string(),
+    "date": pa.date32(),
+    "timestamp": pa.timestamp("us"),
+}
+
+
+def _resolve_dtype(dtype) -> pa.DataType:
+    if isinstance(dtype, pa.DataType):
+        return dtype
+    key = str(dtype).lower()
+    if key in _DTYPE_ALIASES:
+        return _DTYPE_ALIASES[key]
+    raise ValueError(f"unknown dtype {dtype!r}")
+
+
+@dataclass(eq=False)
+class BinaryOp(Expr):
+    op: str  # a pyarrow.compute function of two args
+    left: Expr
+    right: Expr
+
+    def evaluate(self, table: pa.Table):
+        left = self.left.evaluate(table)
+        right = self.right.evaluate(table)
+        if self.op == "mod":  # arrow has no mod kernel: x - (x // y) * y
+            quotient = pc.divide(left, right)
+            if pa.types.is_floating(_value_type(quotient)):
+                quotient = pc.floor(quotient)
+            return pc.subtract(left, pc.multiply(quotient, right))
+        return getattr(pc, self.op)(left, right)
+
+    def name_hint(self) -> str:
+        return f"({self.left.name_hint()} {self.op} {self.right.name_hint()})"
+
+    def references(self) -> List[str]:
+        return self.left.references() + self.right.references()
+
+
+@dataclass(eq=False)
+class UnaryOp(Expr):
+    op: str
+    child: Expr
+
+    def evaluate(self, table: pa.Table):
+        return getattr(pc, self.op)(self.child.evaluate(table))
+
+    def name_hint(self) -> str:
+        return f"{self.op}({self.child.name_hint()})"
+
+    def references(self) -> List[str]:
+        return self.child.references()
+
+
+@dataclass(eq=False)
+class IsIn(Expr):
+    child: Expr
+    values: List[Any]
+
+    def evaluate(self, table: pa.Table):
+        return pc.is_in(self.child.evaluate(table), value_set=pa.array(self.values))
+
+    def references(self) -> List[str]:
+        return self.child.references()
+
+
+@dataclass(eq=False)
+class Function(Expr):
+    """Call an arbitrary pyarrow.compute function over evaluated children."""
+
+    fn: str
+    args: List[Expr]
+    options: Optional[Dict[str, Any]] = None
+
+    def evaluate(self, table: pa.Table):
+        evaluated = [a.evaluate(table) for a in self.args]
+        return getattr(pc, self.fn)(*evaluated, **(self.options or {}))
+
+    def name_hint(self) -> str:
+        return f"{self.fn}({', '.join(a.name_hint() for a in self.args)})"
+
+    def references(self) -> List[str]:
+        out: List[str] = []
+        for a in self.args:
+            out.extend(a.references())
+        return out
+
+
+@dataclass(eq=False)
+class When(Expr):
+    """CASE WHEN chain: when(cond, val).when(...).otherwise(default)."""
+
+    branches: List[Tuple[Expr, Expr]]
+    default: Optional[Expr] = None
+
+    def when(self, cond, value) -> "When":
+        return When(self.branches + [(_to_expr(cond), _to_expr(value))], self.default)
+
+    def otherwise(self, value) -> "When":
+        return When(self.branches, _to_expr(value))
+
+    def evaluate(self, table: pa.Table):
+        conds = pa.StructArray.from_arrays(
+            [_as_array(c.evaluate(table), table.num_rows) for c, _ in self.branches],
+            names=[f"c{i}" for i in range(len(self.branches))],
+        )
+        cases = [v.evaluate(table) for _, v in self.branches]
+        default = (
+            self.default.evaluate(table)
+            if self.default is not None
+            else pa.scalar(None)
+        )
+        return pc.case_when(conds, *cases, default)
+
+    def references(self) -> List[str]:
+        out: List[str] = []
+        for c, v in self.branches:
+            out.extend(c.references())
+            out.extend(v.references())
+        if self.default is not None:
+            out.extend(self.default.references())
+        return out
+
+
+@dataclass(eq=False)
+class Udf(Expr):
+    """Row-vectorized python UDF: fn(*numpy_or_arrow_arrays) -> array-like."""
+
+    func: Callable
+    args: List[Expr]
+    dtype: Optional[Any] = None
+
+    def evaluate(self, table: pa.Table):
+        arrays = [
+            _as_array(a.evaluate(table), table.num_rows) for a in self.args
+        ]
+        result = self.func(*arrays)
+        if isinstance(result, (pa.Array, pa.ChunkedArray)):
+            out = result
+        else:
+            out = pa.array(np.asarray(result))
+        if self.dtype is not None:
+            out = pc.cast(out, _resolve_dtype(self.dtype), safe=False)
+        return out
+
+    def references(self) -> List[str]:
+        out: List[str] = []
+        for a in self.args:
+            out.extend(a.references())
+        return out
+
+
+def _value_type(value) -> pa.DataType:
+    return value.type
+
+
+def _as_array(value, num_rows: int):
+    """Broadcast scalars so struct/case_when see equal-length arrays."""
+    if isinstance(value, pa.Scalar):
+        return pa.repeat(value, num_rows)
+    if isinstance(value, pa.ChunkedArray):
+        return value.combine_chunks()
+    return value
+
+
+# ---------------------------------------------------------------------------
+# Aggregate expressions (used by DataFrame.group_by().agg() and df.agg()).
+# Two-phase: partial per partition, merge on the reducer — this is what makes
+# the shuffle ship pre-aggregated blocks instead of raw rows.
+# ---------------------------------------------------------------------------
+
+# agg -> (map-side arrow agg, reduce-side arrow agg over partials)
+_AGG_PHASES: Dict[str, Tuple[str, str]] = {
+    "sum": ("sum", "sum"),
+    "min": ("min", "min"),
+    "max": ("max", "max"),
+    "count": ("count", "sum"),
+    "first": ("first", "first"),
+    "last": ("last", "last"),
+    "any": ("any", "any"),
+    "all": ("all", "all"),
+}
+
+
+@dataclass(eq=False)
+class AggExpr:
+    """Aggregation of one input column. ``mean`` decomposes into sum+count."""
+
+    agg: str  # sum | min | max | count | mean | first | last | any | all
+    column: str
+    out_name: str
+
+    def __post_init__(self):
+        if self.agg not in _AGG_PHASES and self.agg != "mean":
+            raise ValueError(f"unsupported aggregate {self.agg!r}")
+
+    def alias(self, name: str) -> "AggExpr":
+        return AggExpr(self.agg, self.column, name)

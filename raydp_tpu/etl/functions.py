@@ -1,0 +1,212 @@
+"""User-facing expression builders (the ``F`` namespace of the ETL engine).
+
+Shapes mirror ``pyspark.sql.functions`` so code written against the reference's
+Spark DataFrames (e.g. examples/data_process.py feature engineering) translates
+one-to-one, but everything compiles to vectorized pyarrow.compute kernels.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Union
+
+import numpy as np
+from raydp_tpu.etl.expressions import (
+    AggExpr,
+    Alias,
+    ColumnRef,
+    Expr,
+    Function,
+    Literal,
+    Udf,
+    When,
+    _to_expr,
+)
+
+ColumnLike = Union[str, Expr]
+
+
+def col(name: str) -> Expr:
+    return ColumnRef(name)
+
+
+def lit(value: Any) -> Expr:
+    return Literal(value)
+
+
+def _c(c: ColumnLike) -> Expr:
+    return ColumnRef(c) if isinstance(c, str) else c
+
+
+def _colname(c: ColumnLike) -> str:
+    if isinstance(c, str):
+        return c
+    if isinstance(c, ColumnRef):
+        return c.name
+    if isinstance(c, Alias):
+        return c.name
+    raise ValueError(f"aggregate input must be a column name, got {c!r}")
+
+
+# -- aggregates --------------------------------------------------------------
+
+
+def sum(c: ColumnLike) -> AggExpr:  # noqa: A001 - mirrors pyspark name
+    name = _colname(c)
+    return AggExpr("sum", name, f"sum({name})")
+
+
+def avg(c: ColumnLike) -> AggExpr:
+    name = _colname(c)
+    return AggExpr("mean", name, f"avg({name})")
+
+
+mean = avg
+
+
+def count(c: ColumnLike = "*") -> AggExpr:
+    name = "*" if (isinstance(c, str) and c == "*") else _colname(c)
+    return AggExpr("count", name, "count" if name == "*" else f"count({name})")
+
+
+def min(c: ColumnLike) -> AggExpr:  # noqa: A001
+    name = _colname(c)
+    return AggExpr("min", name, f"min({name})")
+
+
+def max(c: ColumnLike) -> AggExpr:  # noqa: A001
+    name = _colname(c)
+    return AggExpr("max", name, f"max({name})")
+
+
+def first(c: ColumnLike) -> AggExpr:
+    name = _colname(c)
+    return AggExpr("first", name, f"first({name})")
+
+
+# -- scalar functions --------------------------------------------------------
+
+
+def when(cond: Expr, value) -> When:
+    return When([(cond, _to_expr(value))])
+
+
+def coalesce(*cols: ColumnLike) -> Expr:
+    return Function("coalesce", [_c(c) for c in cols])
+
+
+def abs(c: ColumnLike) -> Expr:  # noqa: A001
+    return Function("abs", [_c(c)])
+
+
+def sqrt(c: ColumnLike) -> Expr:
+    return Function("sqrt", [_c(c)])
+
+
+def exp(c: ColumnLike) -> Expr:
+    return Function("exp", [_c(c)])
+
+
+def log(c: ColumnLike) -> Expr:
+    return Function("ln", [_c(c)])
+
+
+def log1p(c: ColumnLike) -> Expr:
+    return Function("log1p", [_c(c)])
+
+
+def floor(c: ColumnLike) -> Expr:
+    return Function("floor", [_c(c)])
+
+
+def ceil(c: ColumnLike) -> Expr:
+    return Function("ceil", [_c(c)])
+
+
+def round(c: ColumnLike, ndigits: int = 0) -> Expr:  # noqa: A001
+    return Function("round", [_c(c)], options={"ndigits": ndigits})
+
+
+def lower(c: ColumnLike) -> Expr:
+    return Function("utf8_lower", [_c(c)])
+
+
+def upper(c: ColumnLike) -> Expr:
+    return Function("utf8_upper", [_c(c)])
+
+
+def trim(c: ColumnLike) -> Expr:
+    return Function("utf8_trim_whitespace", [_c(c)])
+
+
+def length(c: ColumnLike) -> Expr:
+    return Function("utf8_length", [_c(c)])
+
+
+def concat(*cols: ColumnLike) -> Expr:
+    return Function("binary_join_element_wise", [_c(c) for c in cols] + [Literal("")])
+
+
+# -- datetime (NYCTaxi feature engineering uses these heavily) ---------------
+
+
+def year(c: ColumnLike) -> Expr:
+    return Function("year", [_c(c)])
+
+
+def month(c: ColumnLike) -> Expr:
+    return Function("month", [_c(c)])
+
+
+def dayofmonth(c: ColumnLike) -> Expr:
+    return Function("day", [_c(c)])
+
+
+def dayofweek(c: ColumnLike) -> Expr:
+    """1=Sunday .. 7=Saturday, matching the Spark function ported code expects."""
+    return Function(
+        "day_of_week", [_c(c)], options={"count_from_zero": False, "week_start": 7}
+    )
+
+
+def hour(c: ColumnLike) -> Expr:
+    return Function("hour", [_c(c)])
+
+
+def minute(c: ColumnLike) -> Expr:
+    return Function("minute", [_c(c)])
+
+
+def unix_timestamp(c: ColumnLike) -> Expr:
+    """Seconds since epoch as int64 (timestamp stored as us → divide)."""
+    as_us = _c(c).cast("timestamp").cast("int64")
+    return Function("divide", [as_us, Literal(1_000_000)])
+
+
+def to_timestamp(c: ColumnLike, fmt: Optional[str] = None) -> Expr:
+    if fmt is None:
+        return _c(c).cast("timestamp")
+    return Function("strptime", [_c(c)], options={"format": fmt, "unit": "us"})
+
+
+# -- misc --------------------------------------------------------------------
+
+
+def hash(c: ColumnLike, num_buckets: Optional[int] = None) -> Expr:  # noqa: A001
+    """Stable 64-bit hash, optionally bucketed — the DLRM categorical hashing
+    primitive (the reference notebook hashes category strings to embedding
+    ids). Deterministic across processes (siphash, fixed key)."""
+
+    def _hash_fn(values):
+        from raydp_tpu.etl.tasks import stable_hash_column
+
+        hashed = stable_hash_column(values)
+        if num_buckets is not None:
+            hashed = hashed % np.uint64(num_buckets)
+        return hashed.astype(np.int64)
+
+    return Udf(_hash_fn, [_c(c)], dtype="int64")
+
+
+def udf(func: Callable, *cols: ColumnLike, dtype=None) -> Expr:
+    """Vectorized UDF over whole-column arrays (numpy in, array out)."""
+    return Udf(func, [_c(c) for c in cols], dtype)

@@ -1,0 +1,566 @@
+"""Driver-side query planner / stage scheduler.
+
+Walks a logical plan (plan.py), fuses narrow chains, breaks stages at wide
+nodes, and drives executor actors through map/reduce shuffle rounds — the role
+Spark's DAGScheduler plays inside the reference (the hot loop of SURVEY.md
+§3.1), rebuilt Arrow-native on this framework's actor runtime.
+
+Also owns schema inference: the narrow/merge kernels are *executed on empty
+tables* locally, so the inferred schema is by construction what the executors
+will produce (no separate analyzer to drift out of sync).
+"""
+
+from __future__ import annotations
+
+import math
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from raydp_tpu.etl import plan as lp
+from raydp_tpu.etl import tasks as T
+from raydp_tpu.store import object_store as store
+
+
+@dataclass
+class Materialized:
+    """A fully materialized plan: partitions as object-store blocks."""
+
+    schema: pa.Schema
+    blocks: List[Optional[store.ObjectRef]]
+    counts: List[int]  # rows per partition
+
+    @property
+    def num_rows(self) -> int:
+        return sum(self.counts)
+
+
+class Planner:
+    """Executes logical plans over a pool of executors (or in-process when the
+    pool is empty — local mode, used by unit tests and schema probes)."""
+
+    def __init__(
+        self,
+        executors: Optional[Sequence[Any]] = None,
+        default_parallelism: int = 4,
+        owner: Optional[str] = None,
+    ):
+        self.executors = list(executors or [])
+        self.default_parallelism = max(1, default_parallelism)
+        self.owner = owner  # ownership target for produced blocks
+
+    # ------------------------------------------------------------------
+    # task submission
+    # ------------------------------------------------------------------
+
+    def submit(self, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
+        if not self.executors:
+            return [T.run_task(s) for s in specs]
+        futures = []
+        for i, spec in enumerate(specs):
+            executor = self.executors[i % len(self.executors)]
+            futures.append(executor.run_task.remote(spec))
+        return [f.result() for f in futures]
+
+    # ------------------------------------------------------------------
+    # schema inference (run the pipeline on empty tables, locally)
+    # ------------------------------------------------------------------
+
+    def infer_schema(self, node: lp.PlanNode) -> pa.Schema:
+        return self._empty_result(node).schema
+
+    def _empty_result(self, node: lp.PlanNode) -> pa.Table:
+        cached = getattr(node, "_cached_empty", None)
+        if cached is not None:
+            return cached
+        result = self._empty_result_uncached(node)
+        try:
+            node._cached_empty = result  # type: ignore[attr-defined]
+        except AttributeError:
+            pass
+        return result
+
+    def _empty_result_uncached(self, node: lp.PlanNode) -> pa.Table:
+        if isinstance(node, lp.GlobalLimit):
+            return self._empty_result(node.child)
+        if isinstance(node, lp.ArrowSource):
+            return node.schema.empty_table()
+        if isinstance(node, lp.RangeSource):
+            return pa.schema([("id", pa.int64())]).empty_table()
+        if isinstance(node, lp.ParquetSource):
+            import pyarrow.parquet as pq
+
+            schema = pq.read_schema(node.file_groups[0][0])
+            if node.columns:
+                schema = pa.schema([schema.field(c) for c in node.columns])
+            return schema.empty_table()
+        if isinstance(node, lp.CsvSource):
+            # read only the first batch of the first file for column types
+            from pyarrow import csv as pacsv
+
+            opts = node.read_options
+            with pacsv.open_csv(
+                node.file_groups[0][0],
+                read_options=pacsv.ReadOptions(
+                    column_names=opts.get("column_names"),
+                    autogenerate_column_names=opts.get(
+                        "autogenerate_column_names", False
+                    ),
+                ),
+                parse_options=pacsv.ParseOptions(delimiter=opts.get("delimiter", ",")),
+                convert_options=pacsv.ConvertOptions(
+                    column_types=opts.get("column_types")
+                ),
+            ) as reader:
+                return reader.schema.empty_table()
+        if isinstance(node, (lp.Filter, lp.Sample, lp.PartitionHead, lp.Repartition)):
+            return self._empty_result(node.children()[0])
+        if isinstance(node, lp.Project):
+            child = self._empty_result(node.child)
+            return T.apply_narrow(child, node, 0)
+        if isinstance(node, lp.MapBatches):
+            child = self._empty_result(node.child)
+            return T.apply_narrow(child, node, 0)
+        if isinstance(node, lp.Union):
+            return self._empty_result(node.inputs[0])
+        if isinstance(node, lp.GroupByAgg):
+            child = self._empty_result(node.child)
+            return T.final_agg(
+                T.partial_agg(child, node.keys, node.aggs), node.keys, node.aggs
+            )
+        if isinstance(node, lp.Join):
+            left = self._empty_result(node.left)
+            right = self._empty_result(node.right)
+            return left.join(right, keys=node.on, join_type=node.how, use_threads=False)
+        if isinstance(node, (lp.Sort, lp.Distinct)):
+            return self._empty_result(node.children()[0])
+        raise TypeError(f"cannot infer schema for {type(node).__name__}")
+
+    # ------------------------------------------------------------------
+    # materialization
+    # ------------------------------------------------------------------
+
+    def _split_narrow(self, node: lp.PlanNode) -> Tuple[lp.PlanNode, List[lp.PlanNode]]:
+        """Peel the chain of narrow ops off the top of the plan (returned
+        bottom-up, ready to apply in order)."""
+        chain: List[lp.PlanNode] = []
+        current = node
+        while isinstance(
+            current, (lp.Project, lp.Filter, lp.MapBatches, lp.Sample, lp.PartitionHead)
+        ):
+            chain.append(current)
+            current = current.children()[0]
+        chain.reverse()
+        return current, chain
+
+    def _strip_children(self, chain: List[lp.PlanNode]) -> List[lp.PlanNode]:
+        """Detach narrow nodes from their subtrees before shipping (executors
+        only need the op parameters, not the whole plan)."""
+        out: List[lp.PlanNode] = []
+        for n in chain:
+            if isinstance(n, lp.Project):
+                out.append(lp.Project(None, n.columns))  # type: ignore[arg-type]
+            elif isinstance(n, lp.Filter):
+                out.append(lp.Filter(None, n.predicate))  # type: ignore[arg-type]
+            elif isinstance(n, lp.MapBatches):
+                out.append(lp.MapBatches(None, n.fn))  # type: ignore[arg-type]
+            elif isinstance(n, lp.Sample):
+                out.append(lp.Sample(None, n.fraction, n.seed))  # type: ignore[arg-type]
+            elif isinstance(n, lp.PartitionHead):
+                out.append(lp.PartitionHead(None, n.n))  # type: ignore[arg-type]
+            else:
+                raise TypeError(type(n).__name__)
+        return out
+
+    def materialize(self, node: lp.PlanNode) -> Materialized:
+        """Execute to object-store blocks (one per partition)."""
+        results = self._execute(node, T.OutputSpec("block", owner=self.owner))
+        schema = self.infer_schema(node)
+        blocks = [r.blocks[0] if r.blocks else None for r in results]
+        counts = [r.num_rows[0] if r.num_rows else 0 for r in results]
+        return Materialized(schema, blocks, counts)
+
+    def execute_action(self, node: lp.PlanNode, output: T.OutputSpec) -> List[T.TaskResult]:
+        """Run the plan with a custom terminal output (count/inline/parquet)."""
+        return self._execute(node, output)
+
+    # ------------------------------------------------------------------
+    # the recursive stage driver
+    # ------------------------------------------------------------------
+
+    def _execute(self, node: lp.PlanNode, output: T.OutputSpec) -> List[T.TaskResult]:
+        base, chain = self._split_narrow(node)
+        shipped = self._strip_children(chain)
+
+        if isinstance(base, (lp.ArrowSource, lp.RangeSource, lp.ParquetSource, lp.CsvSource)):
+            reads = self._source_reads(base)
+            specs = [
+                T.TaskSpec(reads=[r], chain=shipped, output=output, partition_index=i)
+                for i, r in enumerate(reads)
+            ]
+            return self.submit(specs)
+
+        if isinstance(base, lp.Union):
+            results: List[T.TaskResult] = []
+            for child in base.inputs:
+                # re-root the narrow chain over each input
+                sub = child
+                for n in chain:
+                    sub = self._reroot(n, sub)
+                results.extend(self._execute(sub, output))
+            return results
+
+        if isinstance(base, lp.GlobalLimit):
+            # materialize the limited child exactly (global trim), then run
+            # the remaining chain over the trimmed blocks
+            trimmed = self._materialize_limited(base)
+            schema_ipc = T.schema_ipc_bytes(trimmed.schema)
+            specs = [
+                T.TaskSpec(
+                    reads=[T.ReadSpec("block", blocks=[b], schema_ipc=schema_ipc)],
+                    chain=shipped,
+                    output=output,
+                    partition_index=i,
+                )
+                for i, b in enumerate(trimmed.blocks)
+            ]
+            return self.submit(specs)
+
+        if isinstance(base, lp.Repartition):
+            return self._execute_repartition(base, shipped, output)
+        if isinstance(base, lp.GroupByAgg):
+            return self._execute_groupby(base, shipped, output)
+        if isinstance(base, lp.Join):
+            return self._execute_join(base, shipped, output)
+        if isinstance(base, lp.Sort):
+            return self._execute_sort(base, shipped, output)
+        if isinstance(base, lp.Distinct):
+            return self._execute_distinct(base, shipped, output)
+        raise TypeError(f"cannot execute {type(base).__name__}")
+
+    def _reroot(self, narrow: lp.PlanNode, child: lp.PlanNode) -> lp.PlanNode:
+        import copy
+
+        clone = copy.copy(narrow)
+        if isinstance(clone, lp.Union):
+            raise TypeError("not narrow")
+        clone.child = child  # type: ignore[attr-defined]
+        return clone
+
+    def _source_reads(self, base: lp.PlanNode) -> List[T.ReadSpec]:
+        if isinstance(base, lp.ArrowSource):
+            schema_ipc = T.schema_ipc_bytes(base.schema)
+            return [
+                T.ReadSpec("block", blocks=[b], schema_ipc=schema_ipc)
+                for b in base.blocks
+            ]
+        if isinstance(base, lp.RangeSource):
+            total = max(0, math.ceil((base.end - base.start) / base.step))
+            per = math.ceil(total / base.num_partitions) if base.num_partitions else total
+            reads = []
+            for i in range(base.num_partitions):
+                lo = base.start + i * per * base.step
+                hi = min(base.end, base.start + (i + 1) * per * base.step)
+                reads.append(T.ReadSpec("range", range_args=(lo, max(lo, hi), base.step)))
+            return reads
+        if isinstance(base, lp.ParquetSource):
+            return [
+                T.ReadSpec("parquet", files=g, columns=base.columns)
+                for g in base.file_groups
+            ]
+        if isinstance(base, lp.CsvSource):
+            return [
+                T.ReadSpec("csv", files=g, csv_options=base.read_options)
+                for g in base.file_groups
+            ]
+        raise TypeError(type(base).__name__)
+
+    def _num_partitions(self, requested: Optional[int]) -> int:
+        return requested or self.default_parallelism
+
+    def _shuffle_reads(
+        self,
+        map_results: List[T.TaskResult],
+        num_reducers: int,
+        schema: pa.Schema,
+    ) -> List[T.ReadSpec]:
+        """Transpose map-side split outputs into per-reducer ReadSpecs."""
+        schema_ipc = T.schema_ipc_bytes(schema)
+        reads = []
+        for r in range(num_reducers):
+            blocks = [
+                res.blocks[r]
+                for res in map_results
+                if r < len(res.blocks) and res.blocks[r] is not None
+            ]
+            reads.append(T.ReadSpec("block", blocks=blocks, schema_ipc=schema_ipc))
+        return reads
+
+    def _cleanup_intermediate(self, results: List[T.TaskResult]) -> None:
+        refs = [b for res in results for b in res.blocks if b is not None]
+        if refs:
+            try:
+                store.delete(refs)
+            except Exception:
+                pass  # best-effort: shuffle temp blocks also die with their owner
+
+    def _execute_repartition(
+        self, base: lp.Repartition, chain: List[lp.PlanNode], output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        n = self._num_partitions(base.num_partitions)
+        child_schema = self.infer_schema(base.child)
+        if base.by:
+            map_out = T.OutputSpec("hash_split", num_splits=n, keys=list(base.by))
+        elif base.shuffle_seed is not None:
+            map_out = T.OutputSpec("random_split", num_splits=n, seed=base.shuffle_seed)
+        else:
+            map_out = T.OutputSpec("round_robin_split", num_splits=n)
+        map_results = self._execute(base.child, map_out)
+        reads = self._shuffle_reads(map_results, n, child_schema)
+        shuffle_seed = base.shuffle_seed
+        reduce_chain = list(chain)
+        if shuffle_seed is not None:
+            # shuffle rows *within* each output partition too (true random order)
+            reduce_chain = [
+                lp.MapBatches(None, _IntraShuffle(shuffle_seed))  # type: ignore[arg-type]
+            ] + reduce_chain
+        specs = [
+            T.TaskSpec(
+                reads=[r],
+                merge=T.MergeSpec("none"),
+                chain=reduce_chain,
+                output=output,
+                partition_index=i,
+            )
+            for i, r in enumerate(reads)
+        ]
+        out = self.submit(specs)
+        self._cleanup_intermediate(map_results)
+        return out
+
+    def _execute_groupby(
+        self, base: lp.GroupByAgg, chain: List[lp.PlanNode], output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        n = 1 if not base.keys else self._num_partitions(base.num_partitions)
+        partial = lp.MapBatches(
+            base.child, _PartialAgg(base.keys, base.aggs)
+        )
+        if base.keys:
+            map_out = T.OutputSpec("hash_split", num_splits=n, keys=list(base.keys))
+        else:
+            map_out = T.OutputSpec("block")  # single reducer merges all partials
+        map_results = self._execute(partial, map_out)
+        partial_schema = T.partial_agg(
+            self._empty_result(base.child), base.keys, base.aggs
+        ).schema
+        if base.keys:
+            reads = self._shuffle_reads(map_results, n, partial_schema)
+        else:
+            blocks = [res.blocks[0] for res in map_results if res.blocks and res.blocks[0]]
+            reads = [
+                T.ReadSpec(
+                    "block", blocks=blocks, schema_ipc=T.schema_ipc_bytes(partial_schema)
+                )
+            ]
+        specs = [
+            T.TaskSpec(
+                reads=[r],
+                merge=T.MergeSpec("final_agg", keys=list(base.keys), aggs=list(base.aggs)),
+                chain=chain,
+                output=output,
+                partition_index=i,
+            )
+            for i, r in enumerate(reads)
+        ]
+        out = self.submit(specs)
+        self._cleanup_intermediate(map_results)
+        return out
+
+    def _execute_join(
+        self, base: lp.Join, chain: List[lp.PlanNode], output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        n = self._num_partitions(base.num_partitions)
+        left_schema = self.infer_schema(base.left)
+        right_schema = self.infer_schema(base.right)
+        left_results = self._execute(
+            base.left, T.OutputSpec("hash_split", num_splits=n, keys=list(base.on))
+        )
+        right_results = self._execute(
+            base.right, T.OutputSpec("hash_split", num_splits=n, keys=list(base.on))
+        )
+        left_reads = self._shuffle_reads(left_results, n, left_schema)
+        right_reads = self._shuffle_reads(right_results, n, right_schema)
+        specs = [
+            T.TaskSpec(
+                reads=[left_reads[i]],
+                merge=T.MergeSpec(
+                    "join", keys=list(base.on), right=right_reads[i], join_how=base.how
+                ),
+                chain=chain,
+                output=output,
+                partition_index=i,
+            )
+            for i in range(n)
+        ]
+        out = self.submit(specs)
+        self._cleanup_intermediate(left_results)
+        self._cleanup_intermediate(right_results)
+        return out
+
+    def _execute_sort(
+        self, base: lp.Sort, chain: List[lp.PlanNode], output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        n = self._num_partitions(base.num_partitions)
+        child = self.materialize_node_cached(base.child)
+        schema_ipc = T.schema_ipc_bytes(child.schema)
+        key = base.keys[0]
+        # 1) sample the first sort key from every partition
+        sample_specs = [
+            T.TaskSpec(
+                reads=[T.ReadSpec("block", blocks=[b], schema_ipc=schema_ipc)],
+                output=T.OutputSpec("sample", keys=[key], seed=i, sample_limit=1000),
+                partition_index=i,
+            )
+            for i, b in enumerate(child.blocks)
+        ]
+        samples = [
+            T.ipc_bytes_to_table(r.inline_ipc)
+            for r in self.submit(sample_specs)
+            if r.inline_ipc
+        ]
+        merged = (
+            pa.concat_tables(samples)
+            if samples
+            else pa.table({key: pa.array([], child.schema.field(key).type)})
+        )
+        values = np.sort(merged.column(key).to_numpy(zero_copy_only=False))
+        if len(values) == 0 or n == 1:
+            boundaries = pa.table({key: pa.array([], child.schema.field(key).type)})
+        else:
+            quantile_idx = (np.arange(1, n) * len(values)) // n
+            bounds = values[np.minimum(quantile_idx, len(values) - 1)]
+            boundaries = pa.table(
+                {key: pa.array(np.asarray(bounds), child.schema.field(key).type)}
+            )
+        # 2) range-split every partition
+        map_specs = [
+            T.TaskSpec(
+                reads=[T.ReadSpec("block", blocks=[b], schema_ipc=schema_ipc)],
+                output=T.OutputSpec(
+                    "range_split",
+                    num_splits=n,
+                    keys=[key],
+                    boundaries_ipc=T.table_to_ipc_bytes(boundaries),
+                    ascending=list(base.ascending),
+                ),
+                partition_index=i,
+            )
+            for i, b in enumerate(child.blocks)
+        ]
+        map_results = self.submit(map_specs)
+        reads = self._shuffle_reads(map_results, n, child.schema)
+        # 3) merge + sort each range
+        specs = [
+            T.TaskSpec(
+                reads=[r],
+                merge=T.MergeSpec(
+                    "sort", keys=list(base.keys), ascending=list(base.ascending)
+                ),
+                chain=chain,
+                output=output,
+                partition_index=i,
+            )
+            for i, r in enumerate(reads)
+        ]
+        out = self.submit(specs)
+        self._cleanup_intermediate(map_results)
+        return out
+
+    def _execute_distinct(
+        self, base: lp.Distinct, chain: List[lp.PlanNode], output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        n = self._num_partitions(base.num_partitions)
+        child_schema = self.infer_schema(base.child)
+        keys = list(child_schema.names)
+        dedup = lp.MapBatches(base.child, _LocalDistinct())
+        map_results = self._execute(
+            dedup, T.OutputSpec("hash_split", num_splits=n, keys=keys)
+        )
+        reads = self._shuffle_reads(map_results, n, child_schema)
+        specs = [
+            T.TaskSpec(
+                reads=[r],
+                merge=T.MergeSpec("distinct"),
+                chain=chain,
+                output=output,
+                partition_index=i,
+            )
+            for i, r in enumerate(reads)
+        ]
+        out = self.submit(specs)
+        self._cleanup_intermediate(map_results)
+        return out
+
+    def _materialize_limited(self, limit: lp.GlobalLimit) -> Materialized:
+        """Materialize a GlobalLimit's child (per-partition heads already
+        applied) and trim the block list to exactly n rows."""
+        mat = self.materialize(limit.child)
+        n = limit.n
+        kept: List[Optional[store.ObjectRef]] = []
+        counts: List[int] = []
+        total = 0
+        for b, c in zip(mat.blocks, mat.counts):
+            if total >= n or b is None:
+                continue
+            if total + c <= n:
+                kept.append(b)
+                counts.append(c)
+            else:
+                table = T.read_table_block(b).slice(0, n - total)
+                ref, cnt = T.write_table_block(table, owner=self.owner)
+                kept.append(ref)
+                counts.append(cnt)
+            total += counts[-1]
+        if not kept:  # keep at least one (empty) partition for schema flow
+            ref, cnt = T.write_table_block(mat.schema.empty_table(), owner=self.owner)
+            kept, counts = [ref], [0]
+        return Materialized(mat.schema, kept, counts)
+
+    # cache hook (used by Sort which needs the child twice; DataFrame.cache
+    # replaces the plan with an ArrowSource so this stays trivial)
+    def materialize_node_cached(self, node: lp.PlanNode) -> Materialized:
+        if isinstance(node, lp.ArrowSource):
+            return Materialized(
+                node.schema, list(node.blocks), [-1] * len(node.blocks)
+            )
+        return self.materialize(node)
+
+
+class _PartialAgg:
+    """Picklable map-side aggregation closure."""
+
+    def __init__(self, keys: List[str], aggs: List[Any]):
+        self.keys = keys
+        self.aggs = aggs
+
+    def __call__(self, table: pa.Table) -> pa.Table:
+        return T.partial_agg(table, self.keys, self.aggs)
+
+
+class _LocalDistinct:
+    def __call__(self, table: pa.Table) -> pa.Table:
+        return table.group_by(table.column_names, use_threads=False).aggregate([])
+
+
+class _IntraShuffle:
+    """Shuffle rows within a partition (random_shuffle reduce side)."""
+
+    def __init__(self, seed: int):
+        self.seed = seed
+
+    def __call__(self, table: pa.Table) -> pa.Table:
+        rng = np.random.default_rng(self.seed)
+        order = rng.permutation(table.num_rows)
+        return table.take(pa.array(order))

@@ -1,0 +1,311 @@
+"""ETL session lifecycle: the analog of the reference's ``raydp.init_spark``.
+
+Parity map (SURVEY.md §2 P1-P3, §3.1):
+- ``init_etl(app_name, num_executors, executor_cores, executor_memory, ...)``
+  ↔ ``raydp.init_spark`` (reference context.py:154-231): singleton guarded by
+  an RLock, optional placement-group pre-creation with per-executor bundles,
+  atexit cleanup.
+- ``EtlSession`` ↔ ``_SparkContext`` + ``SparkCluster`` (context.py:32-147,
+  ray_cluster.py:32-155): builds configs, spawns the master/holder actor and
+  one restartable executor actor per requested executor.
+- The named master actor ``<app>_ETL_MASTER`` ↔ ``RayDPSparkMaster``
+  (ray_cluster_master.py:36-213): the long-lived ownership-transfer target so
+  converted data can outlive the session (``stop_etl(cleanup_data=False)``).
+- ``etl.actor.resource.cpu`` config ↔ ``spark.ray.actor.resource.cpu``
+  (SparkOnRayConfigs.java:1-12): actor-scheduling CPU decoupled from task
+  parallelism, enabling fractional-CPU executors.
+
+No JVM anywhere: executors are Python actor processes running Arrow kernels.
+"""
+
+from __future__ import annotations
+
+import atexit
+import os
+import threading
+import uuid
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+import pyarrow as pa
+
+from raydp_tpu.cluster import api as cluster
+from raydp_tpu.etl import plan as lp
+from raydp_tpu.etl.dataframe import DataFrame
+from raydp_tpu.etl.executor import EtlExecutor
+from raydp_tpu.etl.planner import Planner
+from raydp_tpu.etl.tasks import write_table_block
+from raydp_tpu.store.object_store import ObjectHolder
+from raydp_tpu.utils import parse_memory_size
+
+_lock = threading.RLock()
+_active_session: Optional["EtlSession"] = None
+
+MASTER_ACTOR_SUFFIX = "_ETL_MASTER"  # parity: RAYDP_SPARK_MASTER_SUFFIX
+
+
+class EtlSession:
+    """A running ETL engine: master/holder actor + executor actor pool."""
+
+    def __init__(
+        self,
+        app_name: str,
+        num_executors: int,
+        executor_cores: int,
+        executor_memory: Union[str, int],
+        configs: Optional[Dict[str, Any]] = None,
+        placement_group_strategy: Optional[str] = None,
+        placement_group: Optional[cluster.PlacementGroup] = None,
+        placement_group_bundle_indexes: Optional[List[int]] = None,
+    ):
+        self.app_name = app_name
+        self.num_executors = num_executors
+        self.executor_cores = executor_cores
+        self.executor_memory = parse_memory_size(executor_memory)
+        self.configs = dict(configs or {})
+        self.default_parallelism = int(
+            self.configs.get(
+                "etl.default.parallelism", max(2, num_executors * executor_cores)
+            )
+        )
+        self._pg: Optional[cluster.PlacementGroup] = placement_group
+        self._owns_pg = False
+        self._stopped = False
+
+        if not cluster.is_initialized():
+            # resources are logical (the reference CI similarly starts Ray with
+            # --num-cpus 6 on 2-core runners): size the cluster to the session
+            actor_cpu_needed = float(
+                self.configs.get("etl.actor.resource.cpu", executor_cores)
+            )
+            cluster.init(
+                num_cpus=max(
+                    float(os.cpu_count() or 1),
+                    num_executors * actor_cpu_needed + 1.0,
+                ),
+                memory=max(4 << 30, (num_executors + 1) * self.executor_memory),
+            )
+
+        # placement group pre-creation (parity: _prepare_placement_group,
+        # reference context.py:94-113)
+        if placement_group_strategy is not None and placement_group is None:
+            bundles = [
+                {"CPU": float(executor_cores), "memory": float(self.executor_memory)}
+                for _ in range(num_executors)
+            ]
+            self._pg = cluster.create_placement_group(
+                bundles, strategy=placement_group_strategy
+            )
+            self._owns_pg = True
+        self._bundle_indexes = placement_group_bundle_indexes
+
+        # master actor: named, long-lived ownership target
+        self.master = cluster.spawn(
+            ObjectHolder, name=f"{app_name}{MASTER_ACTOR_SUFFIX}", max_restarts=0
+        )
+
+        # executor pool: restartable actors (parity: setMaxRestarts(3),
+        # RayExecutorUtils.java:63); +1 concurrency for data-plane reads
+        # (parity: setMaxConcurrency(2), :65)
+        actor_cpu = float(
+            self.configs.get("etl.actor.resource.cpu", executor_cores)
+        )
+        self.executors = []
+        for i in range(num_executors):
+            bundle = -1
+            if self._pg is not None:
+                indexes = self._bundle_indexes or list(range(num_executors))
+                bundle = indexes[i % len(indexes)]
+            handle = cluster.spawn(
+                EtlExecutor,
+                i,
+                app_name,
+                self.configs,
+                name=f"{app_name}-etl-executor-{i}",
+                num_cpus=actor_cpu,
+                memory=float(self.executor_memory),
+                max_restarts=3,
+                max_concurrency=max(2, executor_cores + 1),
+                placement_group=self._pg.id if self._pg else None,
+                bundle_index=bundle,
+                block=False,
+            )
+            self.executors.append(handle)
+        for handle in self.executors:
+            handle.wait_ready()
+
+        self._planner = Planner(
+            self.executors, default_parallelism=self.default_parallelism
+        )
+
+    # ------------------------------------------------------------------
+    # data sources
+    # ------------------------------------------------------------------
+
+    def range(
+        self, start: int, end: Optional[int] = None, step: int = 1,
+        num_partitions: Optional[int] = None,
+    ) -> DataFrame:
+        if end is None:
+            start, end = 0, start
+        n = num_partitions or self.default_parallelism
+        return DataFrame(self, lp.RangeSource(start, end, step, n))
+
+    def from_arrow(
+        self, table: pa.Table, num_partitions: Optional[int] = None
+    ) -> DataFrame:
+        """Distribute a driver-local Table as object-store partitions."""
+        n = num_partitions or self.default_parallelism
+        n = max(1, min(n, max(1, table.num_rows)))
+        per = -(-table.num_rows // n)
+        blocks = []
+        for i in range(n):
+            chunk = table.slice(i * per, per)
+            ref, _ = write_table_block(chunk)
+            blocks.append(ref)
+        return DataFrame(self, lp.ArrowSource(blocks, table.schema))
+
+    def from_pandas(self, pdf, num_partitions: Optional[int] = None) -> DataFrame:
+        return self.from_arrow(
+            pa.Table.from_pandas(pdf, preserve_index=False), num_partitions
+        )
+
+    createDataFrame = from_pandas
+
+    def from_items(self, rows: List[Dict[str, Any]], num_partitions: Optional[int] = None) -> DataFrame:
+        return self.from_arrow(pa.Table.from_pylist(rows), num_partitions)
+
+    def read_parquet(
+        self, paths: Union[str, Sequence[str]], num_partitions: Optional[int] = None,
+        columns: Optional[List[str]] = None,
+    ) -> DataFrame:
+        files = _expand_files(paths, (".parquet", ".pq"))
+        groups = _group_files(files, num_partitions or self.default_parallelism)
+        return DataFrame(self, lp.ParquetSource(groups, columns))
+
+    def read_csv(
+        self, paths: Union[str, Sequence[str]], num_partitions: Optional[int] = None,
+        **options,
+    ) -> DataFrame:
+        files = _expand_files(paths, (".csv", ".txt", ".tsv", ".gz"))
+        groups = _group_files(files, num_partitions or self.default_parallelism)
+        return DataFrame(self, lp.CsvSource(groups, options))
+
+    # ------------------------------------------------------------------
+    # lifecycle
+    # ------------------------------------------------------------------
+
+    def stop(self, cleanup_data: bool = True, del_obj_holder: bool = True) -> None:
+        """Stop executors (intentional kill: no restart). Blocks owned by the
+        dead executors are GC'd by the head. With ``cleanup_data=False`` the
+        master/holder actor is kept alive, so blocks whose ownership was
+        transferred to it survive the session — the reference's
+        ``stop_spark(cleanup_data=False)`` semantics (context.py:223-231,
+        test_data_owner_transfer.py:79-123)."""
+        global _active_session
+        if self._stopped:
+            return
+        self._stopped = True
+        for handle in self.executors:
+            try:
+                handle.kill(no_restart=True)
+            except Exception:
+                pass
+        self.executors = []
+        if cleanup_data and del_obj_holder:
+            try:
+                self.master.kill(no_restart=True)
+            except Exception:
+                pass
+        if self._owns_pg and self._pg is not None:
+            try:
+                cluster.remove_placement_group(self._pg)
+            except Exception:
+                pass
+            self._pg = None
+        with _lock:
+            if _active_session is self:
+                _active_session = None
+
+    def __enter__(self) -> "EtlSession":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+
+def _expand_files(paths, extensions) -> List[str]:
+    import glob
+    import os
+
+    if isinstance(paths, str):
+        paths = [paths]
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for ext in extensions:
+                out.extend(sorted(glob.glob(os.path.join(p, f"*{ext}"))))
+        elif any(ch in p for ch in "*?["):
+            out.extend(sorted(glob.glob(p)))
+        else:
+            out.append(p)
+    if not out:
+        raise FileNotFoundError(f"no input files matched {paths}")
+    return out
+
+
+def _group_files(files: List[str], num_partitions: int) -> List[List[str]]:
+    n = max(1, min(num_partitions, len(files)))
+    groups: List[List[str]] = [[] for _ in range(n)]
+    for i, f in enumerate(files):
+        groups[i % n].append(f)
+    return groups
+
+
+def init_etl(
+    app_name: str,
+    num_executors: int = 1,
+    executor_cores: int = 1,
+    executor_memory: Union[str, int] = "500M",
+    configs: Optional[Dict[str, Any]] = None,
+    placement_group_strategy: Optional[str] = None,
+    placement_group: Optional[cluster.PlacementGroup] = None,
+    placement_group_bundle_indexes: Optional[List[int]] = None,
+) -> EtlSession:
+    """Start (or return) the singleton ETL session — ``raydp.init_spark``
+    parity (reference context.py:154-231), including the double-init guard."""
+    global _active_session
+    with _lock:
+        if _active_session is not None and not _active_session._stopped:
+            raise RuntimeError(
+                "an ETL session is already running; call stop_etl() first "
+                "(parity: init_spark singleton guard, reference context.py:129-147)"
+            )
+        session = EtlSession(
+            app_name,
+            num_executors,
+            executor_cores,
+            executor_memory,
+            configs=configs,
+            placement_group_strategy=placement_group_strategy,
+            placement_group=placement_group,
+            placement_group_bundle_indexes=placement_group_bundle_indexes,
+        )
+        _active_session = session
+        atexit.register(_atexit_stop)
+        return session
+
+
+def _atexit_stop() -> None:
+    with _lock:
+        if _active_session is not None:
+            _active_session.stop()
+
+
+def stop_etl(cleanup_data: bool = True, del_obj_holder: bool = True) -> None:
+    with _lock:
+        if _active_session is not None:
+            _active_session.stop(cleanup_data=cleanup_data, del_obj_holder=del_obj_holder)
+
+
+def active_session() -> Optional[EtlSession]:
+    return _active_session

@@ -1,0 +1,520 @@
+"""Physical tasks of the ETL engine.
+
+A ``TaskSpec`` is one unit of work an executor actor runs: read inputs
+(object-store blocks, parquet/csv file groups, or a range), optionally merge
+them (shuffle-reduce: final aggregation / join / sort), apply a fused chain of
+narrow ops, and emit output (a sealed Arrow block, hash/range/random splits for
+the next shuffle, a sample of sort keys, or inline rows back to the driver).
+
+This file is pure functions over ``pyarrow.Table`` plus the picklable specs —
+it runs identically on the driver (local fallback) and inside executors. It
+plays the role of the reference's JVM partition loop (Spark task execution
+inside RayDPExecutor actors + ObjectStoreWriter's per-partition Arrow
+serialization, reference ObjectStoreWriter.scala:99-171) in Arrow-native form.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+import pyarrow.compute as pc
+
+from raydp_tpu.etl import plan as lp
+from raydp_tpu.etl.expressions import AggExpr, _AGG_PHASES, _as_array
+from raydp_tpu.store import object_store as store
+
+# ---------------------------------------------------------------------------
+# Block IO helpers
+# ---------------------------------------------------------------------------
+
+DEFAULT_MAX_RECORDS_PER_BATCH = 1 << 15
+
+
+def write_table_block(
+    table: pa.Table,
+    owner: Optional[str] = None,
+    max_records: int = DEFAULT_MAX_RECORDS_PER_BATCH,
+) -> Tuple[store.ObjectRef, int]:
+    """Serialize a Table as an Arrow IPC stream straight into a shared-memory
+    block (no staging copy on the happy path). Returns (ref, num_rows)."""
+    table = table.combine_chunks()
+    capacity = int(table.nbytes) + (1 << 16) + 512 * max(1, table.num_columns)
+    block = store.create_block(capacity)
+    try:
+        sink = block.arrow_sink()
+        with pa.ipc.new_stream(sink, table.schema) as writer:
+            writer.write_table(table, max_chunksize=max_records)
+        written = sink.tell()
+        sink.close()
+        ref = block.seal(written, owner=owner)
+        return ref, table.num_rows
+    except Exception:
+        block.abort()
+        # conservative fallback: serialize to memory, then one copy into shm
+        out = pa.BufferOutputStream()
+        with pa.ipc.new_stream(out, table.schema) as writer:
+            writer.write_table(table, max_chunksize=max_records)
+        ref = store.put(out.getvalue(), owner=owner)
+        return ref, table.num_rows
+
+
+def read_table_block(ref: store.ObjectRef) -> pa.Table:
+    """Zero-copy read of an Arrow-IPC block back into a Table."""
+    schema, batches = store.read_arrow_batches(ref)
+    return pa.Table.from_batches(batches, schema=schema)
+
+
+def table_to_ipc_bytes(table: pa.Table) -> bytes:
+    out = pa.BufferOutputStream()
+    with pa.ipc.new_stream(out, table.schema) as writer:
+        writer.write_table(table)
+    return out.getvalue().to_pybytes()
+
+
+def ipc_bytes_to_table(data: bytes) -> pa.Table:
+    with pa.ipc.open_stream(pa.py_buffer(data)) as reader:
+        return reader.read_all()
+
+
+# ---------------------------------------------------------------------------
+# Task specification
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class ReadSpec:
+    """One input of a task."""
+
+    kind: str  # "block" | "parquet" | "csv" | "range" | "inline"
+    blocks: List[store.ObjectRef] = field(default_factory=list)
+    files: List[str] = field(default_factory=list)
+    columns: Optional[List[str]] = None
+    range_args: Optional[Tuple[int, int, int]] = None  # start, end, step
+    inline_ipc: Optional[bytes] = None
+    csv_options: Dict[str, Any] = field(default_factory=dict)
+    schema_ipc: Optional[bytes] = None  # schema to use when inputs are empty
+
+
+@dataclass
+class MergeSpec:
+    """Shuffle-reduce step applied to the concatenated input."""
+
+    kind: str  # "none" | "final_agg" | "join" | "sort" | "distinct"
+    keys: List[str] = field(default_factory=list)
+    aggs: List[AggExpr] = field(default_factory=list)
+    right: Optional[ReadSpec] = None
+    join_how: str = "inner"
+    ascending: List[bool] = field(default_factory=list)
+
+
+@dataclass
+class OutputSpec:
+    kind: str  # "block" | "hash_split" | "range_split" | "random_split" | "inline" | "count" | "sample"
+    num_splits: int = 1
+    keys: List[str] = field(default_factory=list)
+    boundaries_ipc: Optional[bytes] = None  # for range_split: single-col table per key
+    ascending: List[bool] = field(default_factory=list)
+    seed: Optional[int] = None
+    weights: Optional[List[float]] = None  # random_split probabilities
+    sample_limit: int = 1000
+    path: Optional[str] = None  # parquet output directory
+    owner: Optional[str] = None  # ownership target for produced blocks
+    max_records: int = DEFAULT_MAX_RECORDS_PER_BATCH
+
+
+@dataclass
+class TaskSpec:
+    reads: List[ReadSpec]
+    chain: List[lp.PlanNode] = field(default_factory=list)  # childless narrow nodes
+    merge: MergeSpec = field(default_factory=lambda: MergeSpec("none"))
+    output: OutputSpec = field(default_factory=lambda: OutputSpec("block"))
+    partition_index: int = 0
+
+
+@dataclass
+class TaskResult:
+    """blocks[i] is the output for reducer i (block/…_split) or the single
+    output (block). ``None`` marks an empty split the reducer may skip."""
+
+    blocks: List[Optional[store.ObjectRef]] = field(default_factory=list)
+    num_rows: List[int] = field(default_factory=list)
+    inline_ipc: Optional[bytes] = None
+    count: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Reading
+# ---------------------------------------------------------------------------
+
+
+def _read_one(read: ReadSpec) -> pa.Table:
+    if read.kind == "block":
+        tables = [read_table_block(r) for r in read.blocks if r is not None]
+        tables = [t for t in tables if t.num_rows > 0] or tables[:1]
+        if not tables:
+            if read.schema_ipc is not None:
+                return _empty_table(read.schema_ipc)
+            raise ValueError("block read with no blocks and no schema")
+        return pa.concat_tables(tables, promote_options="permissive")
+    if read.kind == "parquet":
+        import pyarrow.parquet as pq
+
+        tables = [pq.read_table(f, columns=read.columns) for f in read.files]
+        return pa.concat_tables(tables, promote_options="permissive")
+    if read.kind == "csv":
+        from pyarrow import csv as pacsv
+
+        opts = dict(read.csv_options)
+        convert = pacsv.ConvertOptions(
+            column_types=opts.get("column_types"),
+        )
+        read_opts = pacsv.ReadOptions(
+            column_names=opts.get("column_names"),
+            autogenerate_column_names=opts.get("autogenerate_column_names", False),
+        )
+        parse = pacsv.ParseOptions(delimiter=opts.get("delimiter", ","))
+        tables = [
+            pacsv.read_csv(
+                f, read_options=read_opts, parse_options=parse, convert_options=convert
+            )
+            for f in read.files
+        ]
+        return pa.concat_tables(tables, promote_options="permissive")
+    if read.kind == "range":
+        start, end, step = read.range_args
+        return pa.table({"id": pa.array(np.arange(start, end, step, dtype=np.int64))})
+    if read.kind == "inline":
+        return ipc_bytes_to_table(read.inline_ipc)
+    raise ValueError(f"unknown read kind {read.kind!r}")
+
+
+def _empty_table(schema_ipc: bytes) -> pa.Table:
+    schema = pa.ipc.read_schema(pa.py_buffer(schema_ipc))
+    return schema.empty_table()
+
+
+def schema_ipc_bytes(schema: pa.Schema) -> bytes:
+    return schema.serialize().to_pybytes()
+
+
+# ---------------------------------------------------------------------------
+# Narrow chain application
+# ---------------------------------------------------------------------------
+
+
+def apply_narrow(table: pa.Table, node: lp.PlanNode, partition_index: int) -> pa.Table:
+    if isinstance(node, lp.Project):
+        arrays, names = [], []
+        n = table.num_rows
+        for name, expr in node.columns:
+            value = expr.evaluate(table)
+            arrays.append(_as_array(value, n))
+            names.append(name)
+        return pa.Table.from_arrays(arrays, names=names)
+    if isinstance(node, lp.Filter):
+        mask = node.predicate.evaluate(table)
+        if isinstance(mask, pa.Scalar):
+            return table if mask.as_py() else table.slice(0, 0)
+        return table.filter(mask)
+    if isinstance(node, lp.MapBatches):
+        result = node.fn(table)
+        if isinstance(result, pa.RecordBatch):
+            result = pa.Table.from_batches([result])
+        elif not isinstance(result, pa.Table):
+            import pandas as pd
+
+            if isinstance(result, pd.DataFrame):
+                result = pa.Table.from_pandas(result, preserve_index=False)
+            else:
+                raise TypeError(
+                    f"map_batches fn must return Table/RecordBatch/DataFrame, got {type(result)}"
+                )
+        return result
+    if isinstance(node, lp.Sample):
+        rng = np.random.default_rng(
+            None if node.seed is None else node.seed + partition_index
+        )
+        mask = rng.random(table.num_rows) < node.fraction
+        return table.filter(pa.array(mask))
+    if isinstance(node, lp.PartitionHead):
+        return table.slice(0, node.n)
+    raise TypeError(f"not a narrow node: {type(node).__name__}")
+
+
+# ---------------------------------------------------------------------------
+# Aggregation (two-phase)
+# ---------------------------------------------------------------------------
+
+
+def _expand_phases(aggs: Sequence[AggExpr]) -> List[Tuple[str, str, str]]:
+    """(input_col, map_agg, partial_name) triples; mean → sum + count parts."""
+    out = []
+    for i, a in enumerate(aggs):
+        if a.agg == "mean":
+            out.append((a.column, "sum", f"__p{i}_sum"))
+            out.append((a.column, "count", f"__p{i}_cnt"))
+        else:
+            out.append((a.column, _AGG_PHASES[a.agg][0], f"__p{i}"))
+    return out
+
+
+def _grouped_positional(grouped: pa.Table, keys: List[str], agg_names: List[str]) -> pa.Table:
+    """Normalize a group_by().aggregate() result to [keys..., agg_names...]:
+    agg columns are positional (spec order); keys sit first or last depending
+    on the arrow version."""
+    names = grouped.column_names
+    if names[: len(keys)] == keys:
+        key_idx = list(range(len(keys)))
+        agg_idx = list(range(len(keys), len(names)))
+    else:
+        agg_idx = list(range(len(names) - len(keys)))
+        key_idx = list(range(len(names) - len(keys), len(names)))
+    cols = [grouped.column(i) for i in key_idx] + [grouped.column(i) for i in agg_idx]
+    return pa.Table.from_arrays(cols, names=keys + agg_names)
+
+
+def partial_agg(table: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> pa.Table:
+    phases = _expand_phases(aggs)
+    if keys:
+        specs = []
+        for col_name, map_agg, pname in phases:
+            if col_name == "*":
+                specs.append(([], "count_all"))
+            else:
+                specs.append((col_name, map_agg))
+        grouped = table.group_by(keys, use_threads=False).aggregate(specs)
+        return _grouped_positional(grouped, keys, [p for _, _, p in phases])
+    # global aggregation: single partial row
+    arrays, names = [], []
+    for col_name, map_agg, pname in phases:
+        if col_name == "*":
+            value = pa.scalar(table.num_rows, pa.int64())
+        else:
+            column = table.column(col_name)
+            if map_agg == "count":
+                value = pa.scalar(len(column) - column.null_count, pa.int64())
+            elif map_agg == "first":
+                value = column[0] if len(column) else pa.scalar(None, column.type)
+            elif map_agg == "last":
+                value = column[-1] if len(column) else pa.scalar(None, column.type)
+            else:
+                value = getattr(pc, map_agg)(column)
+        arrays.append(pa.array([value.as_py()], type=value.type))
+        names.append(pname)
+    return pa.Table.from_arrays(arrays, names=names)
+
+
+def final_agg(partials: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> pa.Table:
+    """Merge partial rows: re-aggregate with each aggregate's merge function."""
+    phases = _expand_phases(aggs)
+    if keys:
+        merge_specs = [
+            (pname, merge_fn)
+            for (_, _, pname), merge_fn in zip(phases, _merge_fns(aggs))
+        ]
+        merged = partials.group_by(keys, use_threads=False).aggregate(merge_specs)
+        merged = _grouped_positional(merged, keys, [p for _, _, p in phases])
+    else:
+        arrays, names = [], []
+        for (col_name, map_agg, pname), merge_fn in zip(phases, _merge_fns(aggs)):
+            column = partials.column(pname)
+            if merge_fn == "first":
+                value = column[0] if len(column) else pa.scalar(None, column.type)
+            else:
+                value = getattr(pc, merge_fn)(column)
+            arrays.append(pa.array([value.as_py()], type=value.type))
+            names.append(pname)
+        merged = pa.Table.from_arrays(arrays, names=names)
+    # finalize: mean = sum/cnt; rename partials to out names
+    out_arrays = [merged.column(k) for k in keys]
+    out_names = list(keys)
+    for i, a in enumerate(aggs):
+        if a.agg == "mean":
+            total = merged.column(f"__p{i}_sum")
+            cnt = pc.cast(merged.column(f"__p{i}_cnt"), pa.float64())
+            out_arrays.append(pc.divide(pc.cast(total, pa.float64()), cnt))
+        elif a.agg == "count":
+            # count over zero partials must be 0, not null (sum of empty = null)
+            out_arrays.append(
+                pc.coalesce(merged.column(f"__p{i}"), pa.scalar(0, pa.int64()))
+            )
+        else:
+            out_arrays.append(merged.column(f"__p{i}"))
+        out_names.append(a.out_name)
+    return pa.Table.from_arrays(
+        [_as_array(a, merged.num_rows) for a in out_arrays], names=out_names
+    )
+
+
+def _merge_fns(aggs: Sequence[AggExpr]) -> List[str]:
+    out = []
+    for a in aggs:
+        if a.agg == "mean":
+            out.extend(["sum", "sum"])
+        else:
+            out.append(_AGG_PHASES[a.agg][1])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Splitting (shuffle map-side)
+# ---------------------------------------------------------------------------
+
+
+def stable_hash_column(column) -> np.ndarray:
+    """Cross-process-deterministic per-row uint64 hash (the shuffle contract:
+    the same key must land on the same reducer no matter which executor hashed
+    it). pandas hash_array is siphash with a fixed key — stable everywhere."""
+    import pandas as pd
+
+    if isinstance(column, pa.ChunkedArray):
+        column = column.combine_chunks()
+    values = column.to_pandas()
+    return pd.util.hash_array(np.asarray(values)).astype(np.uint64)
+
+
+def _hash_indices(table: pa.Table, keys: List[str], num_splits: int) -> np.ndarray:
+    combined = np.zeros(table.num_rows, dtype=np.uint64)
+    for k in keys:
+        combined = combined * np.uint64(31) + stable_hash_column(table.column(k))
+    return (combined % np.uint64(num_splits)).astype(np.int64)
+
+
+def _range_indices(
+    table: pa.Table, keys: List[str], boundaries: pa.Table, ascending: List[bool]
+) -> np.ndarray:
+    """Assign each row to a range partition via searchsorted on the first key
+    (boundaries were sampled on the same basis)."""
+    key = keys[0]
+    values = table.column(key).combine_chunks().to_numpy(zero_copy_only=False)
+    bounds = boundaries.column(key).to_numpy(zero_copy_only=False)
+    idx = np.searchsorted(bounds, values, side="right")
+    if not ascending[0]:
+        idx = len(bounds) - idx
+    return idx.astype(np.int64)
+
+
+def _split_table(table: pa.Table, indices: np.ndarray, num_splits: int) -> List[pa.Table]:
+    order = np.argsort(indices, kind="stable")
+    sorted_idx = indices[order]
+    taken = table.take(pa.array(order))
+    out = []
+    starts = np.searchsorted(sorted_idx, np.arange(num_splits), side="left")
+    ends = np.searchsorted(sorted_idx, np.arange(num_splits), side="right")
+    for s, e in zip(starts, ends):
+        out.append(taken.slice(s, e - s))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Task execution
+# ---------------------------------------------------------------------------
+
+
+def run_task(spec: TaskSpec) -> TaskResult:
+    tables = [_read_one(r) for r in spec.reads]
+    if spec.merge.kind == "join":
+        left = (
+            pa.concat_tables(tables, promote_options="permissive")
+            if len(tables) > 1
+            else tables[0]
+        )
+        right = _read_one(spec.merge.right)
+        table = left.join(
+            right, keys=spec.merge.keys, join_type=spec.merge.join_how,
+            use_threads=False,
+        )
+    else:
+        table = (
+            pa.concat_tables(tables, promote_options="permissive")
+            if len(tables) > 1
+            else tables[0]
+        )
+        if spec.merge.kind == "final_agg":
+            table = final_agg(table, spec.merge.keys, spec.merge.aggs)
+        elif spec.merge.kind == "sort":
+            table = table.sort_by(
+                [
+                    (k, "ascending" if asc else "descending")
+                    for k, asc in zip(spec.merge.keys, spec.merge.ascending)
+                ]
+            )
+        elif spec.merge.kind == "distinct":
+            table = table.group_by(
+                table.column_names, use_threads=False
+            ).aggregate([])
+
+    for node in spec.chain:
+        table = apply_narrow(table, node, spec.partition_index)
+
+    return _emit(table, spec)
+
+
+def _emit(table: pa.Table, spec: TaskSpec) -> TaskResult:
+    out = spec.output
+    if out.kind == "count":
+        return TaskResult(count=table.num_rows)
+    if out.kind == "inline":
+        return TaskResult(inline_ipc=table_to_ipc_bytes(table), count=table.num_rows)
+    if out.kind == "block":
+        ref, n = write_table_block(table, owner=out.owner, max_records=out.max_records)
+        return TaskResult(blocks=[ref], num_rows=[n])
+    if out.kind == "parquet":
+        import pyarrow.parquet as pq
+
+        os.makedirs(out.path, exist_ok=True)
+        path = os.path.join(out.path, f"part-{spec.partition_index:05d}.parquet")
+        pq.write_table(table, path)
+        return TaskResult(count=table.num_rows)
+    if out.kind == "sample":
+        n = table.num_rows
+        if n > out.sample_limit:
+            rng = np.random.default_rng(out.seed or 0)
+            idx = np.sort(rng.choice(n, size=out.sample_limit, replace=False))
+            table = table.take(pa.array(idx))
+        keep = table.select(out.keys)
+        return TaskResult(inline_ipc=table_to_ipc_bytes(keep), count=n)
+
+    if out.kind == "hash_split":
+        if table.num_rows == 0:
+            indices = np.zeros(0, dtype=np.int64)
+        else:
+            indices = _hash_indices(table, out.keys, out.num_splits)
+    elif out.kind == "range_split":
+        boundaries = ipc_bytes_to_table(out.boundaries_ipc)
+        indices = (
+            _range_indices(table, out.keys, boundaries, out.ascending)
+            if table.num_rows
+            else np.zeros(0, dtype=np.int64)
+        )
+    elif out.kind == "random_split":
+        rng = np.random.default_rng(
+            (out.seed if out.seed is not None else 0) + spec.partition_index
+        )
+        if out.weights is not None:
+            indices = rng.choice(out.num_splits, p=out.weights, size=table.num_rows)
+        else:
+            indices = rng.integers(0, out.num_splits, size=table.num_rows)
+    elif out.kind == "round_robin_split":
+        indices = (
+            np.arange(table.num_rows, dtype=np.int64) + spec.partition_index
+        ) % out.num_splits
+    else:
+        raise ValueError(f"unknown output kind {out.kind!r}")
+
+    splits = _split_table(table, indices.astype(np.int64), out.num_splits)
+    refs: List[Optional[store.ObjectRef]] = []
+    counts: List[int] = []
+    for sub in splits:
+        if sub.num_rows == 0:
+            refs.append(None)
+            counts.append(0)
+        else:
+            ref, n = write_table_block(sub, owner=out.owner, max_records=out.max_records)
+            refs.append(ref)
+            counts.append(n)
+    return TaskResult(blocks=refs, num_rows=counts)
