@@ -363,7 +363,20 @@ class Head:
     def handle_create_actor(self, spec: ActorSpec) -> str:
         with self.lock:
             if spec.name is not None and spec.name in self.named:
-                raise ClusterError(f"actor name {spec.name!r} already taken")
+                # a DEAD holder releases its name (Ray semantics: names are
+                # reusable after the actor dies; get_actor keeps reporting the
+                # dead record only until someone takes the name again). An
+                # actor with a no-restart kill in flight counts as dead too —
+                # its name can never serve requests again.
+                existing = self.actors.get(self.named[spec.name])
+                if (
+                    existing is None
+                    or existing.state == ActorState.DEAD
+                    or existing.intentional_exit
+                ):
+                    del self.named[spec.name]
+                else:
+                    raise ClusterError(f"actor name {spec.name!r} already taken")
             actor = _Actor(spec)
             actor.node_id = self._schedule(actor)
             try:

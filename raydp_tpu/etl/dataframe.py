@@ -334,12 +334,7 @@ class DataFrame:
         return mat
 
     def num_partitions(self) -> int:
-        base = self._plan
-        if isinstance(base, lp.ArrowSource):
-            return len(base.blocks)
-        return len(
-            self._session._planner.execute_action(self._plan, T.OutputSpec("count"))
-        )
+        return self._session._planner.partition_count(self._plan)
 
     def write_parquet(self, path: str) -> int:
         results = self._session._planner.execute_action(

@@ -138,6 +138,27 @@ class Planner:
             return self._empty_result(node.children()[0])
         raise TypeError(f"cannot infer schema for {type(node).__name__}")
 
+    def partition_count(self, node: lp.PlanNode) -> int:
+        """Structural output-partition count — no execution."""
+        if isinstance(node, lp.ArrowSource):
+            return len(node.blocks)
+        if isinstance(node, lp.RangeSource):
+            return node.num_partitions
+        if isinstance(node, (lp.ParquetSource, lp.CsvSource)):
+            return len(node.file_groups)
+        if isinstance(node, lp.Union):
+            return sum(self.partition_count(c) for c in node.inputs)
+        if isinstance(node, lp.GroupByAgg):
+            return 1 if not node.keys else self._num_partitions(node.num_partitions)
+        if isinstance(node, (lp.Join, lp.Sort, lp.Distinct)):
+            return self._num_partitions(node.num_partitions)
+        if isinstance(node, lp.Repartition):
+            return self._num_partitions(node.num_partitions)
+        children = node.children()
+        if children:
+            return self.partition_count(children[0])
+        raise TypeError(f"cannot count partitions of {type(node).__name__}")
+
     # ------------------------------------------------------------------
     # materialization
     # ------------------------------------------------------------------
@@ -190,14 +211,21 @@ class Planner:
     # the recursive stage driver
     # ------------------------------------------------------------------
 
-    def _execute(self, node: lp.PlanNode, output: T.OutputSpec) -> List[T.TaskResult]:
+    def _execute(
+        self, node: lp.PlanNode, output: T.OutputSpec, offset: int = 0
+    ) -> List[T.TaskResult]:
+        """``offset`` shifts partition indices so sibling subplans (union
+        inputs) never share an index — indices seed RNGs and name parquet
+        parts, so collisions silently lose data."""
         base, chain = self._split_narrow(node)
         shipped = self._strip_children(chain)
 
         if isinstance(base, (lp.ArrowSource, lp.RangeSource, lp.ParquetSource, lp.CsvSource)):
             reads = self._source_reads(base)
             specs = [
-                T.TaskSpec(reads=[r], chain=shipped, output=output, partition_index=i)
+                T.TaskSpec(
+                    reads=[r], chain=shipped, output=output, partition_index=offset + i
+                )
                 for i, r in enumerate(reads)
             ]
             return self.submit(specs)
@@ -209,35 +237,38 @@ class Planner:
                 sub = child
                 for n in chain:
                     sub = self._reroot(n, sub)
-                results.extend(self._execute(sub, output))
+                child_results = self._execute(sub, output, offset + len(results))
+                results.extend(child_results)
             return results
 
         if isinstance(base, lp.GlobalLimit):
-            # materialize the limited child exactly (global trim), then run
-            # the remaining chain over the trimmed blocks
-            trimmed = self._materialize_limited(base)
+            # materialize the limited child exactly (global trim), run the
+            # remaining chain over the trimmed blocks, then free intermediates
+            trimmed, scratch = self._materialize_limited(base)
             schema_ipc = T.schema_ipc_bytes(trimmed.schema)
             specs = [
                 T.TaskSpec(
                     reads=[T.ReadSpec("block", blocks=[b], schema_ipc=schema_ipc)],
                     chain=shipped,
                     output=output,
-                    partition_index=i,
+                    partition_index=offset + i,
                 )
                 for i, b in enumerate(trimmed.blocks)
             ]
-            return self.submit(specs)
+            out = self.submit(specs)
+            self._delete_blocks(scratch)
+            return out
 
         if isinstance(base, lp.Repartition):
-            return self._execute_repartition(base, shipped, output)
+            return self._execute_repartition(offset, base, shipped, output)
         if isinstance(base, lp.GroupByAgg):
-            return self._execute_groupby(base, shipped, output)
+            return self._execute_groupby(offset, base, shipped, output)
         if isinstance(base, lp.Join):
-            return self._execute_join(base, shipped, output)
+            return self._execute_join(offset, base, shipped, output)
         if isinstance(base, lp.Sort):
-            return self._execute_sort(base, shipped, output)
+            return self._execute_sort(offset, base, shipped, output)
         if isinstance(base, lp.Distinct):
-            return self._execute_distinct(base, shipped, output)
+            return self._execute_distinct(offset, base, shipped, output)
         raise TypeError(f"cannot execute {type(base).__name__}")
 
     def _reroot(self, narrow: lp.PlanNode, child: lp.PlanNode) -> lp.PlanNode:
@@ -299,7 +330,12 @@ class Planner:
         return reads
 
     def _cleanup_intermediate(self, results: List[T.TaskResult]) -> None:
-        refs = [b for res in results for b in res.blocks if b is not None]
+        self._delete_blocks(
+            [b for res in results for b in res.blocks if b is not None]
+        )
+
+    @staticmethod
+    def _delete_blocks(refs: List[store.ObjectRef]) -> None:
         if refs:
             try:
                 store.delete(refs)
@@ -307,7 +343,7 @@ class Planner:
                 pass  # best-effort: shuffle temp blocks also die with their owner
 
     def _execute_repartition(
-        self, base: lp.Repartition, chain: List[lp.PlanNode], output: T.OutputSpec
+        self, offset: int, base: lp.Repartition, chain: List[lp.PlanNode], output: T.OutputSpec
     ) -> List[T.TaskResult]:
         n = self._num_partitions(base.num_partitions)
         child_schema = self.infer_schema(base.child)
@@ -332,7 +368,7 @@ class Planner:
                 merge=T.MergeSpec("none"),
                 chain=reduce_chain,
                 output=output,
-                partition_index=i,
+                partition_index=offset + i,
             )
             for i, r in enumerate(reads)
         ]
@@ -341,7 +377,7 @@ class Planner:
         return out
 
     def _execute_groupby(
-        self, base: lp.GroupByAgg, chain: List[lp.PlanNode], output: T.OutputSpec
+        self, offset: int, base: lp.GroupByAgg, chain: List[lp.PlanNode], output: T.OutputSpec
     ) -> List[T.TaskResult]:
         n = 1 if not base.keys else self._num_partitions(base.num_partitions)
         partial = lp.MapBatches(
@@ -370,7 +406,7 @@ class Planner:
                 merge=T.MergeSpec("final_agg", keys=list(base.keys), aggs=list(base.aggs)),
                 chain=chain,
                 output=output,
-                partition_index=i,
+                partition_index=offset + i,
             )
             for i, r in enumerate(reads)
         ]
@@ -379,7 +415,7 @@ class Planner:
         return out
 
     def _execute_join(
-        self, base: lp.Join, chain: List[lp.PlanNode], output: T.OutputSpec
+        self, offset: int, base: lp.Join, chain: List[lp.PlanNode], output: T.OutputSpec
     ) -> List[T.TaskResult]:
         n = self._num_partitions(base.num_partitions)
         left_schema = self.infer_schema(base.left)
@@ -400,7 +436,7 @@ class Planner:
                 ),
                 chain=chain,
                 output=output,
-                partition_index=i,
+                partition_index=offset + i,
             )
             for i in range(n)
         ]
@@ -410,10 +446,10 @@ class Planner:
         return out
 
     def _execute_sort(
-        self, base: lp.Sort, chain: List[lp.PlanNode], output: T.OutputSpec
+        self, offset: int, base: lp.Sort, chain: List[lp.PlanNode], output: T.OutputSpec
     ) -> List[T.TaskResult]:
         n = self._num_partitions(base.num_partitions)
-        child = self.materialize_node_cached(base.child)
+        child, child_is_fresh = self.materialize_node_cached(base.child)
         schema_ipc = T.schema_ipc_bytes(child.schema)
         key = base.keys[0]
         # 1) sample the first sort key from every partition
@@ -470,16 +506,18 @@ class Planner:
                 ),
                 chain=chain,
                 output=output,
-                partition_index=i,
+                partition_index=offset + i,
             )
             for i, r in enumerate(reads)
         ]
         out = self.submit(specs)
         self._cleanup_intermediate(map_results)
+        if child_is_fresh:
+            self._delete_blocks([b for b in child.blocks if b is not None])
         return out
 
     def _execute_distinct(
-        self, base: lp.Distinct, chain: List[lp.PlanNode], output: T.OutputSpec
+        self, offset: int, base: lp.Distinct, chain: List[lp.PlanNode], output: T.OutputSpec
     ) -> List[T.TaskResult]:
         n = self._num_partitions(base.num_partitions)
         child_schema = self.infer_schema(base.child)
@@ -495,7 +533,7 @@ class Planner:
                 merge=T.MergeSpec("distinct"),
                 chain=chain,
                 output=output,
-                partition_index=i,
+                partition_index=offset + i,
             )
             for i, r in enumerate(reads)
         ]
@@ -503,10 +541,15 @@ class Planner:
         self._cleanup_intermediate(map_results)
         return out
 
-    def _materialize_limited(self, limit: lp.GlobalLimit) -> Materialized:
+    def _materialize_limited(
+        self, limit: lp.GlobalLimit
+    ) -> Tuple[Materialized, List[store.ObjectRef]]:
         """Materialize a GlobalLimit's child (per-partition heads already
-        applied) and trim the block list to exactly n rows."""
+        applied) and trim the block list to exactly n rows. Also returns every
+        intermediate ref created, for cleanup once consumed (the trimmed reads
+        feed exactly one downstream stage)."""
         mat = self.materialize(limit.child)
+        scratch: List[store.ObjectRef] = [b for b in mat.blocks if b is not None]
         n = limit.n
         kept: List[Optional[store.ObjectRef]] = []
         counts: List[int] = []
@@ -520,22 +563,26 @@ class Planner:
             else:
                 table = T.read_table_block(b).slice(0, n - total)
                 ref, cnt = T.write_table_block(table, owner=self.owner)
+                scratch.append(ref)
                 kept.append(ref)
                 counts.append(cnt)
             total += counts[-1]
         if not kept:  # keep at least one (empty) partition for schema flow
             ref, cnt = T.write_table_block(mat.schema.empty_table(), owner=self.owner)
+            scratch.append(ref)
             kept, counts = [ref], [0]
-        return Materialized(mat.schema, kept, counts)
+        return Materialized(mat.schema, kept, counts), scratch
 
     # cache hook (used by Sort which needs the child twice; DataFrame.cache
     # replaces the plan with an ArrowSource so this stays trivial)
-    def materialize_node_cached(self, node: lp.PlanNode) -> Materialized:
+    def materialize_node_cached(self, node: lp.PlanNode) -> Tuple[Materialized, bool]:
+        """Returns (materialized, fresh): fresh blocks belong to this stage and
+        must be deleted once consumed; an ArrowSource's blocks are borrowed."""
         if isinstance(node, lp.ArrowSource):
             return Materialized(
                 node.schema, list(node.blocks), [-1] * len(node.blocks)
-            )
-        return self.materialize(node)
+            ), False
+        return self.materialize(node), True
 
 
 class _PartialAgg:

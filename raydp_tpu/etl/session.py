@@ -205,12 +205,28 @@ class EtlSession:
         if self._stopped:
             return
         self._stopped = True
-        for handle in self.executors:
+        killed = list(self.executors)
+        for handle in killed:
             try:
                 handle.kill(no_restart=True)
             except Exception:
                 pass
         self.executors = []
+        # drain: wait for the head to reap the executors so their resources
+        # and names are free before a subsequent init_etl schedules
+        import time
+
+        deadline = time.monotonic() + 15.0
+        for handle in killed:
+            while time.monotonic() < deadline:
+                try:
+                    from raydp_tpu.cluster.common import ActorState
+
+                    if handle.state() == ActorState.DEAD:
+                        break
+                except Exception:
+                    break
+                time.sleep(0.05)
         if cleanup_data and del_obj_holder:
             try:
                 self.master.kill(no_restart=True)

@@ -1,0 +1,25 @@
+"""Exchange layer: ETL ↔ training data path with ownership semantics."""
+
+from raydp_tpu.exchange.dataset import (
+    Dataset,
+    dataframe_to_dataset,
+    dataset_to_dataframe,
+    from_etl_recoverable,
+)
+from raydp_tpu.exchange.jax_io import (
+    PrefetchingDeviceIterator,
+    data_sharding,
+    dataset_batches_on_device,
+    device_put_batch,
+)
+
+__all__ = [
+    "Dataset",
+    "PrefetchingDeviceIterator",
+    "data_sharding",
+    "dataframe_to_dataset",
+    "dataset_batches_on_device",
+    "dataset_to_dataframe",
+    "device_put_batch",
+    "from_etl_recoverable",
+]

@@ -1,0 +1,368 @@
+"""Exchange layer: ETL DataFrames ↔ training-side Datasets.
+
+The exchange currency is the Arrow IPC block in the shared-memory object store,
+with the reference's ownership semantics (SURVEY.md L5, §3.2-3.3):
+
+- ``dataframe_to_dataset(df)`` ↔ ``spark_dataframe_to_ray_dataset``
+  (reference dataset.py:174-184): materialize the frame's partitions as blocks;
+  with ``_use_owner=True`` ownership is transferred to the session's master
+  actor so the data outlives the ETL engine
+  (reference dataset.py:157-171, ObjectStoreWriter.scala:64-85).
+- ``dataset_to_dataframe(session, ds)`` ↔ ``ray_dataset_to_spark_dataframe``
+  (reference dataset.py:265-283): zero-copy re-entry into the ETL engine.
+- ``from_etl_recoverable(df)`` ↔ ``from_spark_recoverable``
+  (reference dataset.py:189-209, stack §3.6): blocks carry a recompute
+  lineage — if a block's owner died, the plan is re-executed to
+  re-materialize it (the RecacheRDD analog, RayDPDriverAgent.scala:59-71).
+
+Rank sharding uses ``divide_blocks`` (utils.py) so every rank sees the same
+sample count — the invariant that keeps a multi-host ``pjit`` step from
+deadlocking on ragged batches.
+"""
+
+from __future__ import annotations
+
+import uuid as _uuid
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pyarrow as pa
+
+from raydp_tpu.cluster.common import ClusterError
+from raydp_tpu.etl import plan as lp
+from raydp_tpu.etl import tasks as T
+from raydp_tpu.store import object_store as store
+from raydp_tpu.utils import divide_blocks
+
+
+class Dataset:
+    """Distributed dataset over Arrow blocks in the object store."""
+
+    def __init__(
+        self,
+        blocks: List[store.ObjectRef],
+        schema: pa.Schema,
+        counts: List[int],
+        dataset_uuid: Optional[str] = None,
+        session: Any = None,
+        recover_plan: Optional[lp.PlanNode] = None,
+    ):
+        self.blocks = list(blocks)
+        self.schema = schema
+        self.counts = list(counts)
+        self.uuid = dataset_uuid or _uuid.uuid4().hex
+        self._session = session
+        self._recover_plan = recover_plan
+
+    # ------------------------------------------------------------------
+    # basics
+    # ------------------------------------------------------------------
+
+    @property
+    def num_blocks(self) -> int:
+        return len(self.blocks)
+
+    def count(self) -> int:
+        return sum(self.counts)
+
+    def __len__(self) -> int:
+        return self.count()
+
+    def __repr__(self) -> str:
+        return (
+            f"Dataset(blocks={self.num_blocks}, rows={self.count()}, "
+            f"schema=[{', '.join(self.schema.names)}])"
+        )
+
+    def get_block(self, index: int) -> pa.Table:
+        """Read one block (zero-copy); on owner-death, recover via lineage if
+        this dataset is recoverable."""
+        try:
+            return T.read_table_block(self.blocks[index])
+        except ClusterError:
+            if self._recover_plan is None or self._session is None:
+                raise
+            self._recover_all()
+            return T.read_table_block(self.blocks[index])
+
+    def _recover_all(self) -> None:
+        """Re-execute the producing plan and swap in fresh blocks (coarse
+        re-materialization — the analog of RecacheRDD re-running rdd.count)."""
+        mat = self._session._planner.materialize(self._recover_plan)
+        self.blocks = [b for b in mat.blocks if b is not None]
+        self.counts = [c for b, c in zip(mat.blocks, mat.counts) if b is not None]
+
+    def to_arrow(self) -> pa.Table:
+        tables = [self.get_block(i) for i in range(self.num_blocks)]
+        tables = [t for t in tables if t.num_rows] or [self.schema.empty_table()]
+        return pa.concat_tables(tables, promote_options="permissive")
+
+    def to_pandas(self):
+        return self.to_arrow().to_pandas()
+
+    def take(self, n: int) -> List[Dict[str, Any]]:
+        out: List[Dict[str, Any]] = []
+        for i in range(self.num_blocks):
+            if len(out) >= n:
+                break
+            out.extend(self.get_block(i).slice(0, n - len(out)).to_pylist())
+        return out
+
+    # ------------------------------------------------------------------
+    # transforms (executed through the session's executor pool when present)
+    # ------------------------------------------------------------------
+
+    def _as_plan(self) -> lp.PlanNode:
+        return lp.ArrowSource(self.blocks, self.schema)
+
+    def _run(self, node: lp.PlanNode) -> "Dataset":
+        planner = self._planner()
+        mat = planner.materialize(node)
+        return Dataset(
+            [b for b in mat.blocks if b is not None],
+            mat.schema,
+            [c for b, c in zip(mat.blocks, mat.counts) if b is not None],
+            session=self._session,
+        )
+
+    def _planner(self):
+        if self._session is not None:
+            return self._session._planner
+        from raydp_tpu.etl.planner import Planner
+
+        return Planner(default_parallelism=max(1, self.num_blocks))
+
+    def map_batches(self, fn: Callable[[pa.Table], pa.Table]) -> "Dataset":
+        return self._run(lp.MapBatches(self._as_plan(), fn))
+
+    def filter(self, predicate) -> "Dataset":
+        return self._run(lp.Filter(self._as_plan(), predicate))
+
+    def select(self, columns: Sequence[str]) -> "Dataset":
+        from raydp_tpu.etl.expressions import ColumnRef
+
+        return self._run(
+            lp.Project(self._as_plan(), [(c, ColumnRef(c)) for c in columns])
+        )
+
+    def repartition(self, num_blocks: int) -> "Dataset":
+        return self._run(lp.Repartition(self._as_plan(), num_blocks))
+
+    def random_shuffle(self, seed: int = 0) -> "Dataset":
+        return self._run(
+            lp.Repartition(
+                self._as_plan(),
+                max(1, self.num_blocks),
+                shuffle_seed=seed,
+            )
+        )
+
+    def split(self, n: int, equal: bool = True) -> List["Dataset"]:
+        """Split into n datasets block-wise (for per-worker feeds). With
+        ``equal=True`` uses divide_blocks so every shard has the same row
+        count (oversampling, reference utils.py:149-222)."""
+        if equal:
+            # empty blocks (a filter can zero out a partition) carry no rows
+            # and would trip divide_blocks' every-block-nonempty invariant
+            nonzero = [
+                (i, c) for i, c in enumerate(self.counts) if c > 0
+            ]
+            if len(nonzero) < n:
+                return self._split_rebalanced(n)
+            assignment = divide_blocks([c for _, c in nonzero], n)
+            shards = []
+            for rank in range(n):
+                refs, counts = [], []
+                for local_index, take_rows in assignment[rank]:
+                    block_index = nonzero[local_index][0]
+                    if take_rows == self.counts[block_index]:
+                        refs.append(self.blocks[block_index])
+                        counts.append(take_rows)
+                    else:  # prefix slice materialized as a fresh block
+                        table = self.get_block(block_index).slice(0, take_rows)
+                        ref, cnt = T.write_table_block(table)
+                        refs.append(ref)
+                        counts.append(cnt)
+                shards.append(
+                    Dataset(refs, self.schema, counts, session=self._session)
+                )
+            return shards
+        shards = []
+        per = -(-self.num_blocks // n)
+        for rank in range(n):
+            refs = self.blocks[rank * per : (rank + 1) * per]
+            counts = self.counts[rank * per : (rank + 1) * per]
+            shards.append(Dataset(refs, self.schema, counts, session=self._session))
+        return shards
+
+    def _split_rebalanced(self, n: int) -> List["Dataset"]:
+        """Fewer non-empty blocks than ranks: materialize once and re-slice
+        into n equal fresh blocks (wrapping to oversample the remainder)."""
+        table = self.to_arrow()
+        total = table.num_rows
+        per = max(1, -(-total // n)) if total else 0
+        shards = []
+        for rank in range(n):
+            if total == 0:
+                sliced = self.schema.empty_table()
+            else:
+                start = (rank * per) % total
+                sliced = table.slice(start, per)
+                while sliced.num_rows < per:  # wrap-around top-up
+                    sliced = pa.concat_tables(
+                        [sliced, table.slice(0, per - sliced.num_rows)]
+                    )
+            ref, cnt = T.write_table_block(sliced)
+            shards.append(Dataset([ref], self.schema, [cnt], session=self._session))
+        return shards
+
+    # ------------------------------------------------------------------
+    # training-side feeding
+    # ------------------------------------------------------------------
+
+    def to_numpy(
+        self,
+        feature_columns: Sequence[str],
+        label_column: Optional[str] = None,
+        feature_dtype=np.float32,
+        label_dtype=np.float32,
+    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+        """Materialize as a dense feature matrix [N, F] (+ label vector)."""
+        table = self.to_arrow()
+        cols = [
+            table.column(c).combine_chunks().to_numpy(zero_copy_only=False)
+            for c in feature_columns
+        ]
+        features = np.stack(cols, axis=1).astype(feature_dtype)
+        labels = None
+        if label_column is not None:
+            labels = (
+                table.column(label_column)
+                .combine_chunks()
+                .to_numpy(zero_copy_only=False)
+                .astype(label_dtype)
+            )
+        return features, labels
+
+    def iter_batches(
+        self,
+        batch_size: int,
+        feature_columns: Sequence[str],
+        label_column: Optional[str] = None,
+        shuffle: bool = False,
+        seed: Optional[int] = None,
+        drop_last: bool = False,
+        feature_dtype=np.float32,
+        label_dtype=np.float32,
+    ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        features, labels = self.to_numpy(
+            feature_columns, label_column, feature_dtype, label_dtype
+        )
+        n = len(features)
+        order = np.arange(n)
+        if shuffle:
+            np.random.default_rng(seed).shuffle(order)
+        stop = (n // batch_size) * batch_size if drop_last else n
+        for start in range(0, stop, batch_size):
+            idx = order[start : start + batch_size]
+            yield features[idx], (labels[idx] if labels is not None else None)
+
+    def to_torch(
+        self,
+        feature_columns: Sequence[str],
+        label_column: Optional[str] = None,
+        batch_size: int = 32,
+        shuffle: bool = False,
+        seed: Optional[int] = None,
+    ):
+        """A torch IterableDataset over this dataset's batches (parity:
+        RayMLDataset.to_torch, reference dataset.py:498-581)."""
+        import torch
+
+        outer = self
+
+        class _Iterable(torch.utils.data.IterableDataset):
+            def __iter__(self):
+                for features, labels in outer.iter_batches(
+                    batch_size, feature_columns, label_column, shuffle, seed
+                ):
+                    x = torch.from_numpy(features)
+                    if labels is None:
+                        yield x
+                    else:
+                        yield x, torch.from_numpy(labels)
+
+            def __len__(self):
+                return -(-outer.count() // batch_size)
+
+        return _Iterable()
+
+    # ------------------------------------------------------------------
+    # ownership
+    # ------------------------------------------------------------------
+
+    def transfer_to_master(self) -> None:
+        """Pin blocks in the session's master/holder actor so they survive
+        ``stop_etl(cleanup_data=False)`` (reference _use_owner path)."""
+        if self._session is None:
+            raise ClusterError("dataset has no session to transfer ownership to")
+        self._session.master.add_objects(self.uuid, self.blocks)
+
+    def owners(self) -> List[Optional[str]]:
+        return [store.owner_of(b) for b in self.blocks]
+
+
+# ---------------------------------------------------------------------------
+# conversions
+# ---------------------------------------------------------------------------
+
+
+def dataframe_to_dataset(
+    df,
+    parallelism: Optional[int] = None,
+    _use_owner: bool = False,
+) -> Dataset:
+    """ETL DataFrame → Dataset (reference spark_dataframe_to_ray_dataset,
+    dataset.py:174-184, incl. the optional repartition at :178-181)."""
+    if parallelism is not None and parallelism != df.num_partitions():
+        df = df.repartition(parallelism)
+    mat = df.materialize()
+    blocks = [b for b in mat.blocks if b is not None]
+    counts = [c for b, c in zip(mat.blocks, mat.counts) if b is not None]
+    ds = Dataset(blocks, mat.schema, counts, session=df._session)
+    if _use_owner:
+        ds.transfer_to_master()
+    return ds
+
+
+def dataset_to_dataframe(session, ds: Dataset, parallelism: Optional[int] = None):
+    """Dataset → ETL DataFrame, zero-copy over the same blocks (reference
+    ray_dataset_to_spark_dataframe, dataset.py:265-283)."""
+    from raydp_tpu.etl.dataframe import DataFrame
+
+    df = DataFrame(session, lp.ArrowSource(ds.blocks, ds.schema))
+    if parallelism is not None:
+        df = df.repartition(parallelism)
+    return df
+
+
+def from_etl_recoverable(df, _use_owner: bool = False) -> Dataset:
+    """Fault-tolerant conversion: the dataset remembers the producing plan and
+    re-materializes lost blocks through the (restartable) executor pool —
+    reference from_spark_recoverable semantics (dataset.py:189-209, §3.6)."""
+    import copy
+
+    plan_snapshot = copy.deepcopy(df._plan)
+    mat = df.materialize()
+    blocks = [b for b in mat.blocks if b is not None]
+    counts = [c for b, c in zip(mat.blocks, mat.counts) if b is not None]
+    ds = Dataset(
+        blocks,
+        mat.schema,
+        counts,
+        session=df._session,
+        recover_plan=plan_snapshot,
+    )
+    if _use_owner:
+        ds.transfer_to_master()
+    return ds

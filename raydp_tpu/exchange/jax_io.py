@@ -1,0 +1,107 @@
+"""Host→device feeding: Arrow blocks to sharded ``jax.Array`` batches.
+
+This replaces the reference's locality tricks (plasma owner-IP preferred
+locations, ``to_torch(prefer_node=...)``, reference RayDatasetRDD.scala:53-55,
+dataset.py:536-557) with the TPU-idiomatic path: each host stages its local
+rows once (Arrow → pinned numpy), then batches are placed onto the device mesh
+with a ``NamedSharding`` over the data axis; under ``pjit`` XLA moves shards
+over ICI, never through the host.
+
+``PrefetchingDeviceIterator`` overlaps the host slice + device transfer of
+batch k+1 with the compute of batch k (the reference's analogous machinery is
+the background-thread ``PrefetchedDataLoader``, torch_ml_dataset.py:69-111 —
+here the device copy itself is async, so a depth-1 pipeline suffices).
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+def data_sharding(mesh, *, axis: str = "data", rank: int = 2):
+    """NamedSharding that splits the leading (batch) dim over ``axis``."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    return NamedSharding(mesh, PartitionSpec(axis, *([None] * (rank - 1))))
+
+
+def device_put_batch(batch, mesh, axis: str = "data"):
+    """Place a host batch (array or tuple of arrays) onto the mesh, sharded
+    over the batch dimension. In multi-process mode each process contributes
+    its local rows (``make_array_from_process_local_data``); single-process
+    this is a plain sharded device_put."""
+    import jax
+
+    def _put(x):
+        if x is None:
+            return None
+        x = np.asarray(x)
+        sharding = data_sharding(mesh, axis=axis, rank=max(1, x.ndim))
+        if jax.process_count() > 1:
+            return jax.make_array_from_process_local_data(sharding, x)
+        return jax.device_put(x, sharding)
+
+    if isinstance(batch, (tuple, list)):
+        return type(batch)(_put(x) for x in batch)
+    return _put(batch)
+
+
+class PrefetchingDeviceIterator:
+    """Wraps a host batch iterator; keeps one batch ahead on device.
+
+    jax device transfers are asynchronous, so simply issuing the device_put for
+    the next batch before yielding the current one overlaps H2D with compute.
+    """
+
+    def __init__(self, host_iter: Iterator, mesh, axis: str = "data"):
+        self._host_iter = iter(host_iter)
+        self._mesh = mesh
+        self._axis = axis
+        self._next = None
+        self._advance()
+
+    def _advance(self):
+        try:
+            batch = next(self._host_iter)
+        except StopIteration:
+            self._next = None
+            return
+        self._next = device_put_batch(batch, self._mesh, self._axis)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        if self._next is None:
+            raise StopIteration
+        current = self._next
+        self._advance()
+        return current
+
+
+def dataset_batches_on_device(
+    dataset,
+    mesh,
+    batch_size: int,
+    feature_columns: Sequence[str],
+    label_column: Optional[str] = None,
+    shuffle: bool = False,
+    seed: Optional[int] = None,
+    axis: str = "data",
+    drop_last: bool = True,
+) -> Iterator:
+    """Device-resident (features, labels) batches sharded over the mesh's data
+    axis, with depth-1 prefetch. ``drop_last`` defaults True: static shapes
+    keep the step function at one XLA compilation."""
+    host = dataset.iter_batches(
+        batch_size,
+        feature_columns,
+        label_column,
+        shuffle=shuffle,
+        seed=seed,
+        drop_last=drop_last,
+    )
+    return PrefetchingDeviceIterator(host, mesh, axis=axis)

@@ -9,8 +9,10 @@ and dryrun validate the same code on real chips.
 import os
 import sys
 
-# Must be set before jax (or anything importing jax) loads.
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Must be set before jax (or anything importing jax) loads. Force CPU even if
+# the environment points at a real TPU (JAX_PLATFORMS=axon): tests exercise
+# sharding on the virtual 8-device mesh; bench.py targets the real chip.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -19,6 +21,13 @@ if "xla_force_host_platform_device_count" not in _flags:
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# jax may already be imported at interpreter startup (sitecustomize), in which
+# case the env var was read too late — update the config directly; this works
+# as long as no backend has initialized yet.
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
 
 import pytest  # noqa: E402
 

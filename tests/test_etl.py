@@ -234,6 +234,26 @@ def test_substr_and_dayofweek_parity(session):
     assert row["dow"] == 5  # Spark numbering: 1=Sunday .. 7=Saturday
 
 
+def test_union_write_parquet_no_collision(session):
+    """Union inputs must not share partition indices (parquet part names)."""
+    import tempfile
+
+    a = session.range(4, num_partitions=2)
+    b = session.range(4, 8, num_partitions=2)
+    tmp = tempfile.mkdtemp()
+    written = a.union(b).write_parquet(tmp)
+    assert written == 8
+    assert session.read_parquet(tmp).count() == 8
+
+
+def test_num_partitions_structural(session):
+    df = session.range(100, num_partitions=5)
+    assert df.num_partitions() == 5
+    assert df.filter(F.col("id") > 10).num_partitions() == 5
+    assert df.repartition(3).num_partitions() == 3
+    assert df.union(df).num_partitions() == 10
+
+
 def test_schema_inference_matches_execution(session):
     df = (
         session.range(10, num_partitions=2)
