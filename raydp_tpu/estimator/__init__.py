@@ -1,0 +1,15 @@
+"""Distributed estimators (reference L6: Torch/TF/XGBoost estimators →
+JaxEstimator flagship + parity estimators)."""
+
+from raydp_tpu.estimator.base import EstimatorInterface, EtlEstimatorInterface
+from raydp_tpu.estimator.jax_estimator import JaxEstimator, JaxModel
+from raydp_tpu.estimator.metrics import Metrics, register_metric
+
+__all__ = [
+    "EstimatorInterface",
+    "EtlEstimatorInterface",
+    "JaxEstimator",
+    "JaxModel",
+    "Metrics",
+    "register_metric",
+]

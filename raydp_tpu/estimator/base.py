@@ -1,0 +1,52 @@
+"""Estimator interfaces.
+
+Parity: reference ``EstimatorInterface`` (estimator.py:23-43) and
+``SparkEstimatorInterface._check_and_convert`` (spark/interfaces.py:27-39) —
+the sklearn-style fit/get_model contract plus the ETL-DataFrame adapter mixin.
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Any, Optional
+
+
+class EstimatorInterface(ABC):
+    """sklearn-style distributed estimator: fit on Datasets, export a model."""
+
+    @abstractmethod
+    def fit(self, train_ds, evaluate_ds=None, max_retries: int = 0) -> Any:
+        ...
+
+    @abstractmethod
+    def get_model(self) -> Any:
+        ...
+
+
+class EtlEstimatorInterface(ABC):
+    """Adds fit_on_etl: accepts ETL DataFrames directly and converts through
+    the exchange layer (reference fit_on_spark, torch/estimator.py:332-363)."""
+
+    def _check_and_convert(self, df):
+        from raydp_tpu.etl.dataframe import DataFrame
+
+        if not isinstance(df, DataFrame):
+            raise TypeError(
+                f"expected raydp_tpu.etl.DataFrame, got {type(df).__name__}"
+            )
+        return df
+
+    @abstractmethod
+    def fit_on_etl(
+        self,
+        train_df,
+        evaluate_df=None,
+        fs_directory: Optional[str] = None,
+        stop_etl_after_conversion: bool = False,
+        max_retries: int = 0,
+    ) -> Any:
+        ...
+
+    # migration-friendly alias for users of the reference API
+    def fit_on_spark(self, *args, **kwargs):
+        return self.fit_on_etl(*args, **kwargs)

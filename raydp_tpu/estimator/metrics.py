@@ -1,0 +1,89 @@
+"""Metric registry for estimators.
+
+Replaces the reference's torchmetrics wrapper (TorchMetric,
+torch/torch_metrics.py:21-55) and keras metric-by-name serialization
+(tf/estimator.py:124-136) with pure-JAX streaming metrics: each metric keeps a
+(sum-like, count-like) state so per-batch updates compose across steps and —
+because they are plain jnp ops — run *inside* the jitted step function, with
+the cross-device reduction compiled in.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+import jax.numpy as jnp
+
+# metric: (update(pred, target) -> (value_sum, weight)); result = value_sum/weight
+_REGISTRY: Dict[str, Callable] = {}
+
+
+def register_metric(name: str):
+    def wrap(fn):
+        _REGISTRY[name] = fn
+        return fn
+
+    return wrap
+
+
+@register_metric("mse")
+def _mse(pred, target):
+    pred = pred.reshape(target.shape)
+    return jnp.sum((pred - target) ** 2), target.size
+
+
+@register_metric("mae")
+def _mae(pred, target):
+    pred = pred.reshape(target.shape)
+    return jnp.sum(jnp.abs(pred - target)), target.size
+
+
+@register_metric("rmse")
+def _rmse(pred, target):  # finalized with sqrt in Metrics.compute
+    pred = pred.reshape(target.shape)
+    return jnp.sum((pred - target) ** 2), target.size
+
+
+@register_metric("accuracy")
+def _accuracy(pred, target):
+    if pred.ndim > target.ndim:
+        predicted = jnp.argmax(pred, axis=-1)
+    else:
+        predicted = (pred.reshape(target.shape) > 0.5).astype(target.dtype)
+    return jnp.sum(predicted == target), target.size
+
+
+class Metrics:
+    """A named bundle of streaming metrics with jit-friendly state."""
+
+    def __init__(self, names):
+        self.names = list(names or [])
+        for name in self.names:
+            if name not in _REGISTRY:
+                raise ValueError(
+                    f"unknown metric {name!r}; available: {sorted(_REGISTRY)}"
+                )
+
+    def init_state(self) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
+        return {
+            n: (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
+            for n in self.names
+        }
+
+    def update(self, state, pred, target):
+        out = {}
+        for n in self.names:
+            add_v, add_w = _REGISTRY[n](pred, target)
+            v, w = state[n]
+            out[n] = (v + add_v.astype(jnp.float32), w + jnp.float32(add_w))
+        return out
+
+    def compute(self, state) -> Dict[str, float]:
+        results = {}
+        for n in self.names:
+            v, w = state[n]
+            value = float(v) / max(float(w), 1.0)
+            if n == "rmse":
+                value = value**0.5
+            results[n] = value
+        return results

@@ -139,7 +139,10 @@ class Planner:
         raise TypeError(f"cannot infer schema for {type(node).__name__}")
 
     def partition_count(self, node: lp.PlanNode) -> int:
-        """Structural output-partition count — no execution."""
+        """Structural output-partition count — no execution. For GlobalLimit
+        this is an upper bound (the trim can drop whole blocks)."""
+        if isinstance(node, lp.GlobalLimit):
+            return min(self.partition_count(node.child), max(1, node.n))
         if isinstance(node, lp.ArrowSource):
             return len(node.blocks)
         if isinstance(node, lp.RangeSource):

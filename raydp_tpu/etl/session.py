@@ -71,19 +71,31 @@ class EtlSession:
         self._owns_pg = False
         self._stopped = False
 
+        # resources are logical (the reference CI similarly starts Ray with
+        # --num-cpus 6 on 2-core runners): size the cluster to the session
+        actor_cpu_needed = float(
+            self.configs.get("etl.actor.resource.cpu", executor_cores)
+        )
+        cpus_needed = num_executors * actor_cpu_needed + 1.0
+        memory_needed = (num_executors + 1) * self.executor_memory
         if not cluster.is_initialized():
-            # resources are logical (the reference CI similarly starts Ray with
-            # --num-cpus 6 on 2-core runners): size the cluster to the session
-            actor_cpu_needed = float(
-                self.configs.get("etl.actor.resource.cpu", executor_cores)
-            )
             cluster.init(
-                num_cpus=max(
-                    float(os.cpu_count() or 1),
-                    num_executors * actor_cpu_needed + 1.0,
-                ),
-                memory=max(4 << 30, (num_executors + 1) * self.executor_memory),
+                num_cpus=max(float(os.cpu_count() or 1), cpus_needed),
+                memory=max(4 << 30, memory_needed),
             )
+        else:
+            # an existing cluster may be sized for a smaller earlier session;
+            # grow it with an extra logical node rather than failing to place
+            totals = cluster.total_resources()
+            total_cpu = sum(r.get("CPU", 0.0) for r in totals.values())
+            total_mem = sum(r.get("memory", 0.0) for r in totals.values())
+            if total_cpu < cpus_needed or total_mem < memory_needed:
+                cluster.add_node(
+                    {
+                        "CPU": max(1.0, cpus_needed - total_cpu),
+                        "memory": max(float(1 << 30), memory_needed - total_mem),
+                    }
+                )
 
         # placement group pre-creation (parity: _prepare_placement_group,
         # reference context.py:94-113)

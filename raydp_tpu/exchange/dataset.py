@@ -323,7 +323,9 @@ def dataframe_to_dataset(
     _use_owner: bool = False,
 ) -> Dataset:
     """ETL DataFrame → Dataset (reference spark_dataframe_to_ray_dataset,
-    dataset.py:174-184, incl. the optional repartition at :178-181)."""
+    dataset.py:174-184, incl. the optional repartition at :178-181). The
+    partition-count probe is structural (an upper bound for limit plans), so
+    a requested parallelism that matches it skips the shuffle."""
     if parallelism is not None and parallelism != df.num_partitions():
         df = df.repartition(parallelism)
     mat = df.materialize()
