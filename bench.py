@@ -1,0 +1,193 @@
+"""End-to-end benchmark: ETL → exchange → train on the NYCTaxi MLP workload.
+
+The reference publishes no numbers (BASELINE.md); the tracked north-star is
+samples/sec/chip for the full pipeline vs pure-JAX training throughput on the
+same model/data (target ≥ 0.8× — i.e., the framework's data path must not
+drag the chip). Prints ONE JSON line.
+
+Runs on whatever jax.devices() provides: the real TPU chip under the driver,
+CPU elsewhere (JAX_PLATFORMS=cpu honored despite the image's pre-registered
+TPU plugin).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+
+def _maybe_force_cpu():
+    if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+        import jax
+
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass
+
+
+def make_taxi_frame(session, n_rows: int, parts: int):
+    """Synthetic NYCTaxi-shaped data + the reference pipeline's feature
+    engineering (examples/data_process.py: datetime decomposition, distance)."""
+    import pandas as pd
+
+    from raydp_tpu.etl import functions as F
+
+    rng = np.random.default_rng(7)
+    base = pd.Timestamp("2020-01-01").value // 10**9
+    pickup = base + rng.integers(0, 30 * 24 * 3600, n_rows)
+    duration = rng.integers(120, 3600, n_rows)
+    pdf = pd.DataFrame(
+        {
+            "pickup_ts": pd.to_datetime(pickup, unit="s"),
+            "passenger_count": rng.integers(1, 6, n_rows).astype(np.int64),
+            "pickup_longitude": -74.0 + rng.random(n_rows) * 0.1,
+            "pickup_latitude": 40.7 + rng.random(n_rows) * 0.1,
+            "dropoff_longitude": -74.0 + rng.random(n_rows) * 0.1,
+            "dropoff_latitude": 40.7 + rng.random(n_rows) * 0.1,
+            "fare_amount": (2.5 + duration / 240.0 + rng.random(n_rows)).astype(
+                np.float64
+            ),
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=parts)
+    df = (
+        df.with_column("hour", F.hour("pickup_ts").cast("float32"))
+        .with_column("dow", F.dayofweek("pickup_ts").cast("float32"))
+        .with_column("dx", (F.col("dropoff_longitude") - F.col("pickup_longitude")))
+        .with_column("dy", (F.col("dropoff_latitude") - F.col("pickup_latitude")))
+        .with_column(
+            "dist",
+            F.sqrt(F.col("dx") * F.col("dx") + F.col("dy") * F.col("dy")).cast(
+                "float32"
+            ),
+        )
+        .with_column("pc", F.col("passenger_count").cast("float32"))
+        .with_column("label", F.col("fare_amount").cast("float32"))
+        .select("hour", "dow", "dist", "pc", "label")
+    )
+    return df
+
+
+FEATURES = ["hour", "dow", "dist", "pc"]
+
+
+def bench_framework(n_rows: int, batch: int, epochs: int):
+    import raydp_tpu
+    from raydp_tpu.estimator import JaxEstimator
+    from raydp_tpu.exchange import dataframe_to_dataset
+    from raydp_tpu.models import MLPRegressor
+
+    t0 = time.perf_counter()
+    session = raydp_tpu.init_etl(
+        "bench", num_executors=2, executor_cores=2, executor_memory="1G"
+    )
+    df = make_taxi_frame(session, n_rows, parts=8)
+    ds = dataframe_to_dataset(df)
+    t_etl = time.perf_counter() - t0
+
+    est = JaxEstimator(
+        model=MLPRegressor(),
+        optimizer="adam",
+        loss="mse",
+        feature_columns=FEATURES,
+        label_column="label",
+        batch_size=batch,
+        num_epochs=epochs,
+        learning_rate=1e-3,
+        shuffle=True,
+        seed=0,
+    )
+    t1 = time.perf_counter()
+    est.fit(ds)
+    t_train = time.perf_counter() - t1 - est.compile_seconds_
+    raydp_tpu.stop_etl()
+    trained = (n_rows // batch) * batch * epochs
+    return trained, t_etl, t_train, est.compile_seconds_
+
+
+def bench_pure_jax(n_rows: int, batch: int, epochs: int):
+    """Pure-JAX loop on pre-staged numpy — the throughput ceiling proxy."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from raydp_tpu.models import MLPRegressor
+
+    rng = np.random.default_rng(7)
+    x = rng.random((n_rows, len(FEATURES))).astype(np.float32)
+    y = rng.random(n_rows).astype(np.float32)
+
+    model = MLPRegressor()
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:batch]))
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def loss_fn(p):
+            pred = model.apply(p, xb)
+            return jnp.mean((pred.reshape(yb.shape) - yb) ** 2)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    steps_per_epoch = n_rows // batch
+    # warm the compile so both sides measure steady-state throughput
+    params, opt_state, _ = step(
+        params, opt_state, jnp.asarray(x[:batch]), jnp.asarray(y[:batch])
+    )
+    jax.block_until_ready(params)
+    t0 = time.perf_counter()
+    order = np.arange(n_rows)
+    for epoch in range(epochs):
+        np.random.default_rng(epoch).shuffle(order)
+        for s in range(steps_per_epoch):
+            idx = order[s * batch : (s + 1) * batch]
+            params, opt_state, loss = step(
+                params, opt_state, jnp.asarray(x[idx]), jnp.asarray(y[idx])
+            )
+    jax.block_until_ready(params)
+    elapsed = time.perf_counter() - t0
+    return steps_per_epoch * batch * epochs, elapsed
+
+
+def main():
+    _maybe_force_cpu()
+    n_rows = int(os.environ.get("BENCH_ROWS", 200_000))
+    batch = int(os.environ.get("BENCH_BATCH", 1024))
+    epochs = int(os.environ.get("BENCH_EPOCHS", 3))
+
+    trained, t_etl, t_train, t_compile = bench_framework(n_rows, batch, epochs)
+    framework_sps = trained / (t_etl + t_train)
+
+    base_trained, base_time = bench_pure_jax(n_rows, batch, epochs)
+    baseline_sps = base_trained / base_time
+
+    result = {
+        "metric": "nyctaxi_mlp_e2e",
+        "value": round(framework_sps, 1),
+        "unit": "samples/sec/chip",
+        "vs_baseline": round((trained / t_train) / baseline_sps, 4),
+        "detail": {
+            "etl_s": round(t_etl, 2),
+            "train_s": round(t_train, 2),
+            "compile_s": round(t_compile, 2),
+            "train_only_sps": round(trained / t_train, 1),
+            "pure_jax_sps": round(baseline_sps, 1),
+            "e2e_sps_incl_etl": round(framework_sps, 1),
+            "rows": n_rows,
+            "batch": batch,
+            "epochs": epochs,
+        },
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

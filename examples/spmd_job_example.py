@@ -1,0 +1,22 @@
+"""SPMD job launcher — the reference's doc/mpi.md example reshaped: ship a
+function to a gang of rank actors and gather results (no mpirun, no gRPC)."""
+
+import raydp_tpu
+
+
+def main():
+    job = raydp_tpu.create_spmd_job("demo", world_size=4).start()
+    try:
+        results = job.run(lambda ctx: f"hello from rank {ctx.rank}/{ctx.world_size}")
+        for line in results:
+            print(line)
+
+        # numeric allreduce-style aggregation via gather
+        partials = job.run(lambda ctx: sum(range(ctx.rank * 100, (ctx.rank + 1) * 100)))
+        print("sum over ranks:", sum(partials))
+    finally:
+        job.stop()
+
+
+if __name__ == "__main__":
+    main()

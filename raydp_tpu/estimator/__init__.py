@@ -4,6 +4,7 @@ JaxEstimator flagship + parity estimators)."""
 from raydp_tpu.estimator.base import EstimatorInterface, EtlEstimatorInterface
 from raydp_tpu.estimator.jax_estimator import JaxEstimator, JaxModel
 from raydp_tpu.estimator.metrics import Metrics, register_metric
+from raydp_tpu.estimator.torch_estimator import TorchEstimator
 
 __all__ = [
     "EstimatorInterface",
@@ -11,5 +12,6 @@ __all__ = [
     "JaxEstimator",
     "JaxModel",
     "Metrics",
+    "TorchEstimator",
     "register_metric",
 ]

@@ -12,8 +12,6 @@ from __future__ import annotations
 
 from typing import Callable, Dict, Tuple
 
-import jax.numpy as jnp
-
 # metric: (update(pred, target) -> (value_sum, weight)); result = value_sum/weight
 _REGISTRY: Dict[str, Callable] = {}
 
@@ -28,24 +26,32 @@ def register_metric(name: str):
 
 @register_metric("mse")
 def _mse(pred, target):
+    import jax.numpy as jnp
+
     pred = pred.reshape(target.shape)
     return jnp.sum((pred - target) ** 2), target.size
 
 
 @register_metric("mae")
 def _mae(pred, target):
+    import jax.numpy as jnp
+
     pred = pred.reshape(target.shape)
     return jnp.sum(jnp.abs(pred - target)), target.size
 
 
 @register_metric("rmse")
 def _rmse(pred, target):  # finalized with sqrt in Metrics.compute
+    import jax.numpy as jnp
+
     pred = pred.reshape(target.shape)
     return jnp.sum((pred - target) ** 2), target.size
 
 
 @register_metric("accuracy")
 def _accuracy(pred, target):
+    import jax.numpy as jnp
+
     if pred.ndim > target.ndim:
         predicted = jnp.argmax(pred, axis=-1)
     else:
@@ -64,13 +70,17 @@ class Metrics:
                     f"unknown metric {name!r}; available: {sorted(_REGISTRY)}"
                 )
 
-    def init_state(self) -> Dict[str, Tuple[jnp.ndarray, jnp.ndarray]]:
+    def init_state(self) -> Dict[str, Tuple]:
+        import jax.numpy as jnp
+
         return {
             n: (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32))
             for n in self.names
         }
 
     def update(self, state, pred, target):
+        import jax.numpy as jnp
+
         out = {}
         for n in self.names:
             add_v, add_w = _REGISTRY[n](pred, target)

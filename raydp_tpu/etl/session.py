@@ -76,7 +76,12 @@ class EtlSession:
         actor_cpu_needed = float(
             self.configs.get("etl.actor.resource.cpu", executor_cores)
         )
-        cpus_needed = num_executors * actor_cpu_needed + 1.0
+        # placement-group bundles reserve full executor_cores each, even when
+        # fractional actor CPUs are configured — size for whichever is larger
+        per_executor_cpu = actor_cpu_needed
+        if placement_group_strategy is not None or placement_group is not None:
+            per_executor_cpu = max(per_executor_cpu, float(executor_cores))
+        cpus_needed = num_executors * per_executor_cpu + 1.0
         memory_needed = (num_executors + 1) * self.executor_memory
         if not cluster.is_initialized():
             cluster.init(

@@ -1,0 +1,14 @@
+"""Model zoo: the reference's workload families, TPU-native."""
+
+from raydp_tpu.models.dlrm import DLRM, dlrm_sharding_rules
+from raydp_tpu.models.mlp import MLPClassifier, MLPRegressor
+from raydp_tpu.models.transformer import TransformerLM, sequence_parallel_apply
+
+__all__ = [
+    "DLRM",
+    "MLPClassifier",
+    "MLPRegressor",
+    "TransformerLM",
+    "dlrm_sharding_rules",
+    "sequence_parallel_apply",
+]

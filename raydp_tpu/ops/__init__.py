@@ -1,0 +1,14 @@
+"""TPU ops: fused kernels (pallas) with XLA fallbacks."""
+
+from raydp_tpu.ops.embedding import (
+    embedding_lookup_vocab_sharded,
+    sharded_embedding_lookup,
+)
+from raydp_tpu.ops.interaction import dot_interaction, dot_interaction_pallas
+
+__all__ = [
+    "dot_interaction",
+    "dot_interaction_pallas",
+    "embedding_lookup_vocab_sharded",
+    "sharded_embedding_lookup",
+]

@@ -1,0 +1,178 @@
+"""Ring attention: exact attention over sequences sharded across devices.
+
+Long-context is first-class in this framework (the reference has no sequence
+axis at all — SURVEY.md §5 "long-context: absent"). The sequence is sharded
+over a mesh axis; each device holds a Q/K/V block. K/V blocks rotate around
+the ring via ``lax.ppermute`` while every device accumulates its Q block's
+attention with the numerically-stable online-softmax update (flash-attention
+statistics: running max m, denominator l, unnormalized output o). After
+``axis_size`` steps every Q block has attended to the full sequence — exact
+attention, O(T/N) memory per device, and the permute overlaps with compute
+under XLA's latency-hiding scheduler on ICI.
+
+Causal masking uses global block offsets derived from ``lax.axis_index``:
+a rotated K/V block j contributes fully when j < i, triangularly when j == i,
+and not at all when j > i (those steps still run — uniform control flow — but
+are masked to -inf so the softmax ignores them).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30
+
+
+def _block_attn(q, k, v, mask):
+    """Scores for one (Q-block, K-block) pair + masked online-softmax stats.
+
+    q: [B, H, Tq, D], k/v: [B, H, Tk, D], mask: [Tq, Tk] bool (True = keep).
+    Returns (o_un, m, l): unnormalized output, row max, row denom.
+    """
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    scores = jnp.where(mask[None, None], scores, NEG_INF)
+    m = jnp.max(scores, axis=-1)  # [B,H,Tq]
+    # guard all-masked rows: exp(NEG_INF - NEG_INF) would be 1, so zero them
+    row_valid = jnp.any(mask, axis=-1)[None, None]  # [1,1,Tq broadcast]
+    p = jnp.exp(scores - m[..., None])
+    p = jnp.where(mask[None, None], p, 0.0)
+    l = jnp.sum(p, axis=-1)  # noqa: E741 - flash-attention notation
+    o = jnp.einsum("bhqk,bhkd->bhqd", p, v)
+    m = jnp.where(row_valid, m, NEG_INF)
+    return o, m, l
+
+
+def _merge(o1, m1, l1, o2, m2, l2):
+    """Combine two online-softmax partials (standard flash merge)."""
+    m = jnp.maximum(m1, m2)
+    a1 = jnp.exp(m1 - m)
+    a2 = jnp.exp(m2 - m)
+    o = o1 * a1[..., None] + o2 * a2[..., None]
+    l = l1 * a1 + l2 * a2  # noqa: E741
+    return o, m, l
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Call inside ``shard_map`` (or any SPMD context where ``axis_name`` is
+    bound). Shapes are per-device: q, k, v: [B, H, T_local, D]; the global
+    sequence is ``T_local * axis_size`` in ring order.
+    """
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    b, h, t, d = q.shape
+
+    q_pos = jnp.arange(t)
+    k_pos = jnp.arange(k.shape[2])
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def block_mask(s):
+        src = (my_idx - s) % n  # which block the current K/V originated from
+        if causal:
+            # global positions: query row qi in block my_idx vs key kj in src
+            gq = my_idx * t + q_pos
+            gk = src * k.shape[2] + k_pos
+            return gq[:, None] >= gk[None, :]
+        return jnp.ones((t, k.shape[2]), bool)
+
+    # step 0: the local block, no communication
+    o, m, l = _block_attn(q, k, v, block_mask(0))  # noqa: E741
+
+    def step(carry, s):
+        o, m, l, k_cur, v_cur = carry  # noqa: E741
+        # permute FIRST, then attend — no dead rotation after the last use
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        o2, m2, l2 = _block_attn(q, k_cur, v_cur, block_mask(s))
+        o, m, l = _merge(o, m, l, o2, m2, l2)  # noqa: E741
+        return (o, m, l, k_cur, v_cur), None
+
+    if n > 1:
+        (o, m, l, _, _), _ = lax.scan(  # noqa: E741
+            step, (o, m, l, k, v), jnp.arange(1, n)
+        )
+    return o / jnp.maximum(l[..., None], 1e-30)
+
+
+def ring_attention_sharded(
+    q, k, v, mesh, axis: str = "sp", causal: bool = False
+):
+    """Convenience wrapper: q/k/v are global arrays sharded over ``axis`` on
+    the sequence dim; runs ring_attention under shard_map."""
+    from jax.sharding import PartitionSpec as P
+
+    try:
+        from jax import shard_map
+    except ImportError:  # older jax
+        from jax.experimental.shard_map import shard_map
+
+    spec = P(None, None, axis, None)
+
+    fn = shard_map(
+        partial(ring_attention, axis_name=axis, causal=causal),
+        mesh=mesh,
+        in_specs=(spec, spec, spec),
+        out_specs=spec,
+    )
+    return fn(q, k, v)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+) -> jnp.ndarray:
+    """DeepSpeed-Ulysses-style sequence parallelism: all-to-all swaps the
+    sharded dim from sequence to heads, attention runs locally on full
+    sequences for H/N heads, then all-to-all swaps back. Cheaper than a ring
+    when H divides the axis and the full sequence fits one device's memory
+    budget; call inside shard_map. Per-device shapes: [B, H, T_local, D]."""
+    n = lax.axis_size(axis_name)
+    b, h, t, d = q.shape
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by sequence axis {n}")
+
+    def seq_to_heads(x):
+        # [B, H, T_local, D] -> [B, H/N, T_global, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+    def heads_to_seq(x):
+        # [B, H/N, T_global, D] -> [B, H, T_local, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    tg = qg.shape[2]
+    scale = d**-0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", qg, kg) * scale
+    if causal:
+        pos = jnp.arange(tg)
+        scores = jnp.where(pos[:, None] >= pos[None, :], scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    og = jnp.einsum("bhqk,bhkd->bhqd", probs, vg)
+    return heads_to_seq(og)
+
+
+def full_attention(q, k, v, causal: bool = False) -> jnp.ndarray:
+    """Single-device reference implementation (for tests and small models)."""
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = scores.shape[-2:]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)

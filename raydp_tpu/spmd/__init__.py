@@ -1,0 +1,14 @@
+"""SPMD runtime: job launcher (MPI-on-Ray parity) + jax.distributed bootstrap."""
+
+from raydp_tpu.spmd.bootstrap import initialize_from_env, process_rank, world_size
+from raydp_tpu.spmd.job import SpmdJob, SpmdWorker, WorkerContext, create_spmd_job
+
+__all__ = [
+    "SpmdJob",
+    "SpmdWorker",
+    "WorkerContext",
+    "create_spmd_job",
+    "initialize_from_env",
+    "process_rank",
+    "world_size",
+]

@@ -1,0 +1,24 @@
+"""The driver's entry points must always compile and run on the CPU mesh."""
+
+import sys
+import os
+
+import numpy as np
+
+
+def test_entry_jits(cpu_mesh_devices):
+    import jax
+
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__
+
+    fn, args = __graft_entry__.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape[0] == 32 and np.all(np.isfinite(np.asarray(out)))
+
+
+def test_dryrun_multichip_8(cpu_mesh_devices):
+    sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    import __graft_entry__
+
+    __graft_entry__.dryrun_multichip(8)

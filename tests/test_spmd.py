@@ -1,0 +1,88 @@
+"""SPMD job launcher tests — reference test_mpi.py shape (:28-126): start/
+run/stop/restart, rank identity, ordering, placement."""
+
+import numpy as np
+import pytest
+
+from raydp_tpu.cluster import api as cluster
+from raydp_tpu.spmd import create_spmd_job
+
+
+@pytest.fixture(autouse=True, scope="module")
+def _cluster():
+    if not cluster.is_initialized():
+        cluster.init(num_cpus=8)
+    yield
+
+
+def test_run_returns_rank_ordered():
+    job = create_spmd_job("spmd-basic", world_size=3).start()
+    try:
+        results = job.run(lambda ctx: (ctx.rank, ctx.world_size))
+        assert results == [(0, 3), (1, 3), (2, 3)]
+        # second function keeps working (ordering advances)
+        doubled = job.run(lambda ctx: ctx.rank * 2)
+        assert doubled == [0, 2, 4]
+    finally:
+        job.stop()
+
+
+def test_env_and_numpy_work_in_ranks():
+    job = create_spmd_job(
+        "spmd-env", world_size=2, env={"MY_FLAG": "42"}
+    ).start()
+    try:
+        def fn(ctx):
+            import os
+
+            import numpy as np
+
+            return os.environ["MY_FLAG"], int(np.sum(np.arange(ctx.rank + 3)))
+
+        results = job.run(fn)
+        assert results == [("42", 3), ("42", 6)]
+    finally:
+        job.stop()
+
+
+def test_restart_resets_function_ordering():
+    job = create_spmd_job("spmd-restart", world_size=2).start()
+    try:
+        job.run(lambda ctx: ctx.rank)
+        job.restart()
+        assert job.run(lambda ctx: "after-restart") == ["after-restart"] * 2
+    finally:
+        job.stop()
+
+
+def test_start_twice_raises():
+    job = create_spmd_job("spmd-twice", world_size=1).start()
+    try:
+        with pytest.raises(RuntimeError, match="already started"):
+            job.start()
+    finally:
+        job.stop()
+
+
+def test_worker_exception_propagates():
+    job = create_spmd_job("spmd-err", world_size=2).start()
+    try:
+        def boom(ctx):
+            if ctx.rank == 1:
+                raise ValueError("rank 1 exploded")
+            return "ok"
+
+        with pytest.raises(ValueError, match="rank 1 exploded"):
+            job.run(boom)
+    finally:
+        job.stop()
+
+
+def test_placement_group_released_after_stop():
+    before = len(cluster.placement_group_table())
+    job = create_spmd_job("spmd-pg", world_size=2).start()
+    during = len(cluster.placement_group_table())
+    job.stop()
+    after = len(cluster.placement_group_table())
+    assert during == before + 1
+    assert after == before
