@@ -131,6 +131,7 @@ class ActorRecord:
     node_ip: Optional[str]
     restarts_used: int = 0
     error: Optional[str] = None
+    resources: Dict[str, float] = dataclasses.field(default_factory=dict)
 
 
 @dataclasses.dataclass

@@ -86,6 +86,7 @@ class _Actor:
             node_ip=node_ip,
             restarts_used=self.restarts_used,
             error=self.error,
+            resources=dict(self.spec.resources),
         )
 
 

@@ -4,7 +4,9 @@ JaxEstimator flagship + parity estimators)."""
 from raydp_tpu.estimator.base import EstimatorInterface, EtlEstimatorInterface
 from raydp_tpu.estimator.jax_estimator import JaxEstimator, JaxModel
 from raydp_tpu.estimator.metrics import Metrics, register_metric
+from raydp_tpu.estimator.tf_estimator import TFEstimator
 from raydp_tpu.estimator.torch_estimator import TorchEstimator
+from raydp_tpu.estimator.xgboost_estimator import XGBoostEstimator
 
 __all__ = [
     "EstimatorInterface",
@@ -12,6 +14,8 @@ __all__ = [
     "JaxEstimator",
     "JaxModel",
     "Metrics",
+    "TFEstimator",
     "TorchEstimator",
+    "XGBoostEstimator",
     "register_metric",
 ]

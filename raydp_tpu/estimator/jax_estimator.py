@@ -152,6 +152,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         label_dtype=np.float32,
         param_sharding_rules: Optional[Callable] = None,
         donate_state: bool = True,
+        profile_dir: Optional[str] = None,
+        resume_from_epoch: Optional[int] = None,
     ):
         self._model_arg = model
         self._optimizer_arg = optimizer
@@ -170,6 +172,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         self.label_dtype = label_dtype
         self.param_sharding_rules = param_sharding_rules
         self.donate_state = donate_state
+        self.profile_dir = profile_dir
+        self.resume_from_epoch = resume_from_epoch
 
         self._module = None
         self._params = None
@@ -303,7 +307,6 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             if device != jax.devices()[0]:
                 params = jax.device_put(params, device)
                 opt_state = jax.device_put(opt_state, device)
-        opt_state = tx.init(params)
 
         donate = (0, 1, 2) if self.donate_state else ()
 
@@ -324,11 +327,33 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
         eval_step = self._make_eval_step(module, loss_fn)
 
+        start_epoch = 0
+        if self.resume_from_epoch is not None:
+            # step-level resume (beyond the reference's model-only
+            # checkpointing, SURVEY.md §5): reload params at the checkpointed
+            # epoch and continue — the recovery path when a slice fails
+            if not self.checkpoint_dir:
+                raise ValueError("resume_from_epoch requires checkpoint_dir")
+            restored = self.load_checkpoint(self.resume_from_epoch)
+            params = jax.device_put(
+                restored, jax.tree.map(lambda p: p.sharding, params)
+            )
+            opt_state = tx.init(params)
+            start_epoch = self.resume_from_epoch + 1
+
+        import contextlib
+
+        profile_ctx = (
+            jax.profiler.trace(self.profile_dir)
+            if self.profile_dir
+            else contextlib.nullcontext()
+        )
+
         self._history = []
         self.compile_seconds_ = init_compile
         first_step_done = False
-        with mesh:
-            for epoch in range(self.num_epochs):
+        with profile_ctx, mesh:
+            for epoch in range(start_epoch, self.num_epochs):
                 epoch_start = time.perf_counter()
                 epoch_seed = None if not self.shuffle else self.seed + epoch
                 train_iter = PrefetchingDeviceIterator(
@@ -503,22 +528,6 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
 
 def _dataset_from_parquet(directory: str):
-    """Driver-local parquet → Dataset (one block per file)."""
-    import glob
+    from raydp_tpu.exchange.dataset import dataset_from_parquet
 
-    import pyarrow.parquet as pq
-
-    from raydp_tpu.etl.tasks import write_table_block
-    from raydp_tpu.exchange.dataset import Dataset
-
-    files = sorted(glob.glob(os.path.join(directory, "*.parquet")))
-    if not files:
-        raise FileNotFoundError(f"no parquet files under {directory}")
-    blocks, counts, schema = [], [], None
-    for f in files:
-        table = pq.read_table(f)
-        schema = table.schema
-        ref, n = write_table_block(table)
-        blocks.append(ref)
-        counts.append(n)
-    return Dataset(blocks, schema, counts)
+    return dataset_from_parquet(directory)

@@ -149,6 +149,7 @@ class EtlSession:
             self.executors.append(handle)
         for handle in self.executors:
             handle.wait_ready()
+        self._next_executor_id = num_executors
 
         self._planner = Planner(
             self.executors, default_parallelism=self.default_parallelism
@@ -206,6 +207,74 @@ class EtlSession:
         files = _expand_files(paths, (".csv", ".txt", ".tsv", ".gz"))
         groups = _group_files(files, num_partitions or self.default_parallelism)
         return DataFrame(self, lp.CsvSource(groups, options))
+
+    # ------------------------------------------------------------------
+    # dynamic allocation (reference doRequestTotalExecutors/doKillExecutors,
+    # RayCoarseGrainedSchedulerBackend.scala:229-252)
+    # ------------------------------------------------------------------
+
+    def request_total_executors(self, total: int) -> int:
+        """Scale the executor pool up to ``total`` (no-op when already at or
+        above). Returns the live executor count."""
+        actor_cpu = float(self.configs.get("etl.actor.resource.cpu", self.executor_cores))
+        grow = total - len(self.executors)
+        if grow > 0:
+            # ensure capacity (resources are logical; mirror the init sizing)
+            available = cluster.available_resources()
+            free_cpu = sum(r.get("CPU", 0.0) for r in available.values())
+            free_mem = sum(r.get("memory", 0.0) for r in available.values())
+            need_cpu = grow * actor_cpu
+            need_mem = grow * float(self.executor_memory)
+            if free_cpu < need_cpu or free_mem < need_mem:
+                cluster.add_node(
+                    {
+                        "CPU": max(1.0, need_cpu - free_cpu),
+                        "memory": max(float(1 << 30), need_mem - free_mem),
+                    }
+                )
+        while len(self.executors) < total:
+            i = self._next_executor_id
+            self._next_executor_id += 1
+            handle = cluster.spawn(
+                EtlExecutor,
+                i,
+                self.app_name,
+                self.configs,
+                name=f"{self.app_name}-etl-executor-{i}",
+                num_cpus=actor_cpu,
+                memory=float(self.executor_memory),
+                max_restarts=3,
+                max_concurrency=max(2, self.executor_cores + 1),
+            )
+            self.executors.append(handle)
+        self._planner.executors = list(self.executors)
+        return len(self.executors)
+
+    def kill_executors(self, count: int = 1) -> int:
+        """Scale down by killing ``count`` executors (intentional exit: no
+        restart). Blocks they produced are GC'd by ownership."""
+        import time
+
+        from raydp_tpu.cluster.common import ActorState
+
+        victims = self.executors[-count:] if count else []
+        self.executors = self.executors[: len(self.executors) - len(victims)]
+        for handle in victims:
+            try:
+                handle.kill(no_restart=True)
+            except Exception:
+                pass
+        deadline = time.monotonic() + 15.0
+        for handle in victims:
+            while time.monotonic() < deadline:
+                try:
+                    if handle.state() == ActorState.DEAD:
+                        break
+                except Exception:
+                    break
+                time.sleep(0.05)
+        self._planner.executors = list(self.executors)
+        return len(self.executors)
 
     # ------------------------------------------------------------------
     # lifecycle
@@ -313,6 +382,16 @@ def init_etl(
                 "an ETL session is already running; call stop_etl() first "
                 "(parity: init_spark singleton guard, reference context.py:129-147)"
             )
+        # operator overrides from raydp-tpu-submit win over application args
+        # (spark-submit --conf precedence, reference bin/raydp-submit)
+        from raydp_tpu.submit import submitted_overrides
+
+        overrides = submitted_overrides()
+        num_executors = overrides.get("num_executors", num_executors)
+        executor_cores = overrides.get("executor_cores", executor_cores)
+        executor_memory = overrides.get("executor_memory", executor_memory)
+        if overrides.get("configs"):
+            configs = {**(configs or {}), **overrides["configs"]}
         session = EtlSession(
             app_name,
             num_executors,

@@ -6,6 +6,7 @@ from raydp_tpu.exchange.dataset import (
     dataset_to_dataframe,
     from_etl_recoverable,
 )
+from raydp_tpu.exchange.ml_dataset import MLDataset
 from raydp_tpu.exchange.jax_io import (
     PrefetchingDeviceIterator,
     data_sharding,
@@ -15,6 +16,7 @@ from raydp_tpu.exchange.jax_io import (
 
 __all__ = [
     "Dataset",
+    "MLDataset",
     "PrefetchingDeviceIterator",
     "data_sharding",
     "dataframe_to_dataset",

@@ -348,6 +348,34 @@ def dataset_to_dataframe(session, ds: Dataset, parallelism: Optional[int] = None
     return df
 
 
+def dataset_from_parquet(paths) -> Dataset:
+    """Driver-local parquet → Dataset (one block per file). Accepts a
+    directory, a file path, or a list of either."""
+    import glob
+    import os
+
+    import pyarrow.parquet as pq
+
+    if isinstance(paths, str):
+        paths = [paths]
+    files: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            files.extend(sorted(glob.glob(os.path.join(p, "*.parquet"))))
+        else:
+            files.append(p)
+    if not files:
+        raise FileNotFoundError(f"no parquet files in {paths}")
+    blocks, counts, schema = [], [], None
+    for f in files:
+        table = pq.read_table(f)
+        schema = table.schema
+        ref, n = T.write_table_block(table)
+        blocks.append(ref)
+        counts.append(n)
+    return Dataset(blocks, schema, counts)
+
+
 def from_etl_recoverable(df, _use_owner: bool = False) -> Dataset:
     """Fault-tolerant conversion: the dataset remembers the producing plan and
     re-materializes lost blocks through the (restartable) executor pool —

@@ -144,24 +144,31 @@ class SpmdJob:
                 self._owns_pg = True
             indexes = self._bundle_indexes or list(range(self.world_size))
             self._workers = []
-            for rank in range(self.world_size):
-                handle = cluster.spawn(
-                    SpmdWorker,
-                    self.job_name,
-                    rank,
-                    self.world_size,
-                    self.env,
-                    name=f"{self.job_name}-rank-{rank}",
-                    num_cpus=self.num_cpus_per_worker,
-                    placement_group=self._pg.id,
-                    bundle_index=indexes[rank % len(indexes)],
-                    max_restarts=0,
-                    max_concurrency=2,
-                    block=False,
-                )
-                self._workers.append(handle)
-            for handle in self._workers:
-                handle.wait_ready(timeout=self.timeout)
+            try:
+                for rank in range(self.world_size):
+                    handle = cluster.spawn(
+                        SpmdWorker,
+                        self.job_name,
+                        rank,
+                        self.world_size,
+                        self.env,
+                        name=f"{self.job_name}-rank-{rank}",
+                        num_cpus=self.num_cpus_per_worker,
+                        placement_group=self._pg.id,
+                        bundle_index=indexes[rank % len(indexes)],
+                        max_restarts=0,
+                        max_concurrency=2,
+                        block=False,
+                    )
+                    self._workers.append(handle)
+                for handle in self._workers:
+                    handle.wait_ready(timeout=self.timeout)
+            except BaseException:
+                # don't leak actors/PG when a rank fails to come up: the
+                # caller never gets a handle to stop()
+                self._started = True  # let stop() run its full path
+                self.stop()
+                raise
             self._started = True
             return self
 

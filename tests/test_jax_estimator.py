@@ -121,6 +121,28 @@ def test_checkpoint_save_and_load(session, linear_df):
     )
 
 
+def test_resume_from_checkpoint(session, linear_df):
+    """Step-level resume: restart training from a checkpointed epoch."""
+    import tempfile
+
+    ckpt = tempfile.mkdtemp()
+    ds = dataframe_to_dataset(linear_df)
+    est = JaxEstimator(
+        model=_mlp(), feature_columns=["x", "y"], label_column="z",
+        batch_size=128, num_epochs=3, checkpoint_dir=ckpt, seed=0,
+    )
+    est.fit(ds)
+
+    resumed = JaxEstimator(
+        model=_mlp(), feature_columns=["x", "y"], label_column="z",
+        batch_size=128, num_epochs=5, checkpoint_dir=ckpt, seed=0,
+        resume_from_epoch=2,
+    )
+    history = resumed.fit(ds)
+    assert [r["epoch"] for r in history] == [3, 4]
+    assert os.path.isdir(os.path.join(ckpt, "epoch_4"))
+
+
 def test_batch_sharded_over_mesh(session, linear_df, cpu_mesh_devices):
     """The train step must actually run sharded: batch size is rounded up to
     a multiple of the mesh and each device sees batch/8 rows."""

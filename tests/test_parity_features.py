@@ -1,0 +1,141 @@
+"""Parity features: submit CLI overrides, dynamic allocation, MLDataset
+facade, ClusterResources, placement-group strategies (reference
+test_spark_cluster.py:127-164), fractional executor CPUs (conftest.py:76-113).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import raydp_tpu
+from raydp_tpu.cluster import api as cluster
+from raydp_tpu.etl import functions as F
+
+
+def test_submit_overrides(tmp_path):
+    """raydp-tpu-submit config must win over app args (spark-submit parity)."""
+    script = tmp_path / "app.py"
+    script.write_text(
+        "import raydp_tpu\n"
+        "s = raydp_tpu.init_etl('submitted', num_executors=1, executor_cores=1)\n"
+        "assert s.num_executors == 2, s.num_executors\n"
+        "assert s.configs['etl.default.parallelism'] == '6'\n"
+        "assert s.range(10).count() == 10\n"
+        "raydp_tpu.stop_etl()\n"
+        "print('SUBMIT-OK')\n"
+    )
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [os.path.dirname(os.path.dirname(os.path.abspath(__file__)))]
+        + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "raydp_tpu.submit",
+            "--num-executors", "2",
+            "--conf", "etl.default.parallelism=6",
+            str(script),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+    )
+    assert "SUBMIT-OK" in out.stdout, out.stdout + out.stderr
+
+
+def test_dynamic_allocation():
+    session = raydp_tpu.init_etl(
+        "dyn-alloc", num_executors=1, executor_cores=1, executor_memory="200M"
+    )
+    try:
+        assert len(session.executors) == 1
+        assert session.range(100, num_partitions=4).count() == 100
+
+        assert session.request_total_executors(3) == 3
+        assert session.range(100, num_partitions=4).count() == 100
+
+        assert session.kill_executors(2) == 1
+        assert session.range(100, num_partitions=4).count() == 100
+    finally:
+        raydp_tpu.stop_etl()
+
+
+def test_ml_dataset_facade():
+    from raydp_tpu.exchange import MLDataset
+
+    session = raydp_tpu.init_etl(
+        "mlds", num_executors=1, executor_cores=1, executor_memory="200M"
+    )
+    try:
+        pdf = pd.DataFrame(
+            {"a": np.arange(100, dtype=np.float32), "b": np.arange(100, dtype=np.float32)}
+        )
+        df = session.from_pandas(pdf, num_partitions=4)
+        mlds = MLDataset.from_etl(df, num_shards=2)
+        assert mlds.num_shards == 2
+        assert mlds.get_shard(0).count() == mlds.get_shard(1).count()
+        loader = mlds.to_torch(0, ["a"], "b", batch_size=10)
+        batches = list(loader)
+        assert len(batches) >= 1
+    finally:
+        raydp_tpu.stop_etl()
+
+
+def test_cluster_resources():
+    from raydp_tpu.cluster.resources import ClusterResources
+
+    if not cluster.is_initialized():
+        cluster.init(num_cpus=4)
+    totals = ClusterResources.total_resources()
+    assert totals.get("CPU", 0) >= 1
+    assert ClusterResources.total_alive_nodes() >= 1
+    assert ClusterResources.satisfy({"CPU": 0.5})
+    assert not ClusterResources.satisfy({"CPU": 10_000.0})
+
+
+@pytest.mark.parametrize("strategy", ["PACK", "SPREAD", "STRICT_PACK"])
+def test_placement_group_strategies(strategy):
+    """Reference test_placement_group (test_spark_cluster.py:127-164): session
+    works under every PG strategy and the PG is removed at stop."""
+    before = len(cluster.placement_group_table()) if cluster.is_initialized() else 0
+    session = raydp_tpu.init_etl(
+        f"pg-{strategy.lower()}",
+        num_executors=2,
+        executor_cores=1,
+        executor_memory="200M",
+        placement_group_strategy=strategy,
+    )
+    try:
+        assert session.range(50).count() == 50
+        assert len(cluster.placement_group_table()) == before + 1
+    finally:
+        raydp_tpu.stop_etl()
+    assert len(cluster.placement_group_table()) == before
+
+
+def test_fractional_executor_cpu():
+    """Reference spark_on_ray_fractional_cpu (conftest.py:76-87): actor CPU
+    decoupled from task parallelism via etl.actor.resource.cpu."""
+    session = raydp_tpu.init_etl(
+        "frac-cpu",
+        num_executors=2,
+        executor_cores=2,
+        executor_memory="200M",
+        configs={"etl.actor.resource.cpu": 0.5},
+    )
+    try:
+        assert session.range(100, num_partitions=4).count() == 100
+        # both executors fit in 1 logical CPU total
+        used = 0.0
+        for record in cluster.list_actors():
+            if record.name and "frac-cpu-etl-executor" in record.name:
+                used += record.resources.get("CPU", 0.0)
+        assert used == 1.0
+    finally:
+        raydp_tpu.stop_etl()

@@ -1,0 +1,65 @@
+"""TFEstimator parity tests (reference test_tf.py:33-77): keras linear model
+on z = 3x + 4y + 5 across MultiWorkerMirroredStrategy workers."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import raydp_tpu
+
+tf = pytest.importorskip("tensorflow")
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = raydp_tpu.init_etl(
+        "test-tf", num_executors=2, executor_cores=1, executor_memory="300M"
+    )
+    yield s
+    raydp_tpu.stop_etl()
+
+
+def _keras_model():
+    import tensorflow as tf
+
+    return tf.keras.Sequential(
+        [
+            tf.keras.layers.Input(shape=(2,)),
+            tf.keras.layers.Dense(32, activation="relu"),
+            tf.keras.layers.Dense(1),
+        ]
+    )
+
+
+@pytest.mark.parametrize("num_workers", [1, 2])
+def test_tf_fit_on_etl(session, num_workers):
+    from raydp_tpu.estimator import TFEstimator
+
+    rng = np.random.default_rng(0)
+    n = 2048
+    x = rng.random(n).astype(np.float32)
+    y = rng.random(n).astype(np.float32)
+    pdf = pd.DataFrame({"x": x, "y": y, "z": 3 * x + 4 * y + 5})
+    df = session.from_pandas(pdf, num_partitions=4)
+
+    est = TFEstimator(
+        model=_keras_model,
+        optimizer=tf.keras.optimizers.Adam(0.01),
+        loss="mse",
+        metrics=["mae"],
+        feature_columns=["x", "y"],
+        label_column="z",
+        batch_size=64,
+        num_epochs=8,
+        num_workers=num_workers,
+        seed=0,
+    )
+    history = est.fit_on_etl(df)
+    losses = history["loss"]
+    assert len(losses) == 8
+    assert losses[-1] < losses[0] * 0.5
+    assert losses[-1] < 1.0
+
+    model = est.get_model()
+    pred = model.predict(np.array([[0.5, 0.5]], dtype=np.float32), verbose=0)
+    assert abs(float(pred[0, 0]) - 8.5) < 2.0
