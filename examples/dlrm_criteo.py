@@ -7,6 +7,7 @@ Synthetic Criteo-shaped data by default; argv[1] = path to a Criteo tsv
 sample to run the real preprocessing (13 int + 26 categorical columns).
 """
 
+import os
 import sys
 
 import numpy as np
@@ -38,7 +39,8 @@ def main():
     session = raydp_tpu.init_etl(
         "dlrm", num_executors=2, executor_cores=2, executor_memory="1G"
     )
-    df = session.from_pandas(synthetic_criteo(50_000), num_partitions=8)
+    rows = int(os.environ.get("EXAMPLE_ROWS", 50_000))
+    df = session.from_pandas(synthetic_criteo(rows), num_partitions=8)
 
     # preprocessing (notebook parity): log1p the dense ints, hash categories
     for i in range(NUM_DENSE):
@@ -66,7 +68,7 @@ def main():
         feature_columns=features,
         label_column="label",
         batch_size=512,
-        num_epochs=3,
+        num_epochs=int(os.environ.get("EXAMPLE_EPOCHS", 3)),
         learning_rate=1e-3,
         mesh=mesh,
         param_sharding_rules=dlrm_sharding_rules(),

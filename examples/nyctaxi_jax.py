@@ -7,6 +7,7 @@ Uses synthetic taxi-shaped data by default; pass a parquet directory of real
 NYCTaxi data as argv[1] to run on it.
 """
 
+import os
 import sys
 
 import numpy as np
@@ -44,7 +45,8 @@ def main():
     if len(sys.argv) > 1:
         df = session.read_parquet(sys.argv[1])
     else:
-        df = session.from_pandas(synthetic_taxi(100_000), num_partitions=8)
+        rows = int(os.environ.get("EXAMPLE_ROWS", 100_000))
+        df = session.from_pandas(synthetic_taxi(rows), num_partitions=8)
 
     df = (
         df.with_column("hour", F.hour("pickup_ts").cast("float32"))
@@ -70,7 +72,7 @@ def main():
         feature_columns=["hour", "dow", "dist", "pc"],
         label_column="label",
         batch_size=256,
-        num_epochs=5,
+        num_epochs=int(os.environ.get("EXAMPLE_EPOCHS", 5)),
         learning_rate=1e-3,
     )
     history = est.fit_on_etl(train_df, test_df, stop_etl_after_conversion=True)

@@ -19,6 +19,7 @@ from typing import Any, Callable, List, Optional, Sequence, Tuple
 import numpy as np
 import pyarrow as pa
 
+from raydp_tpu.cluster.common import ActorDiedError as _ActorDied
 from raydp_tpu.etl import plan as lp
 from raydp_tpu.etl import tasks as T
 from raydp_tpu.store import object_store as store
@@ -55,14 +56,44 @@ class Planner:
     # task submission
     # ------------------------------------------------------------------
 
+    MAX_TASK_RETRIES = 2
+
+    def _dispatch(self, spec: T.TaskSpec, i: int, attempt: int):
+        """Send a task, skipping permanently-dead executors (a DEAD actor
+        raises ActorDiedError at call time; RESTARTING ones block instead)."""
+        last_exc: Optional[BaseException] = None
+        n = len(self.executors)
+        for offset in range(n):
+            executor = self.executors[(i + attempt + offset) % n]
+            try:
+                return executor.run_task.remote(spec)
+            except _ActorDied as exc:
+                last_exc = exc
+        raise last_exc  # every executor is dead
+
     def submit(self, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
+        """Run tasks across the pool; a task whose executor died mid-flight is
+        retried on another executor (Spark task-retry parity — executor actors
+        restart, so transient deaths must not fail the query). Only connection
+        breakage retries: timeouts and remote application errors propagate
+        (a slow task re-executed elsewhere would duplicate side effects)."""
         if not self.executors:
             return [T.run_task(s) for s in specs]
-        futures = []
-        for i, spec in enumerate(specs):
-            executor = self.executors[i % len(self.executors)]
-            futures.append(executor.run_task.remote(spec))
-        return [f.result() for f in futures]
+        futures = [(self._dispatch(spec, i, 0), spec, i) for i, spec in enumerate(specs)]
+        results: List[Optional[T.TaskResult]] = [None] * len(specs)
+        for attempt in range(self.MAX_TASK_RETRIES + 1):
+            retry: List[Tuple[Any, T.TaskSpec, int]] = []
+            for future, spec, i in futures:
+                try:
+                    results[i] = future.result()
+                except (ConnectionError, EOFError, _ActorDied):
+                    if attempt == self.MAX_TASK_RETRIES:
+                        raise
+                    retry.append((self._dispatch(spec, i, attempt + 1), spec, i))
+            if not retry:
+                break
+            futures = retry
+        return results  # type: ignore[return-value]
 
     # ------------------------------------------------------------------
     # schema inference (run the pipeline on empty tables, locally)

@@ -1,0 +1,105 @@
+"""Elastic recovery tests — the reference's only elasticity test is
+test_reconstruction (kill a node, assert converted blocks recover,
+test_spark_cluster.py:166-196). Here:
+
+- executor crash (SIGKILL, not intentional) → actor restarts (max_restarts=3)
+  and subsequent queries work;
+- blocks survive an executor *crash* (shm persists, owner comes back) but die
+  on *intentional* stop — the kill-vs-crash distinction the reference encodes
+  at ApplicationInfo.scala:119-124;
+- recoverable datasets re-materialize after total block loss.
+"""
+
+import time
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import raydp_tpu
+from raydp_tpu.cluster import api as cluster
+from raydp_tpu.cluster.common import ActorState
+from raydp_tpu.etl import functions as F
+from raydp_tpu.exchange import dataframe_to_dataset, from_etl_recoverable
+
+
+@pytest.fixture()
+def session():
+    s = raydp_tpu.init_etl(
+        "test-elastic", num_executors=2, executor_cores=1, executor_memory="200M"
+    )
+    yield s
+    raydp_tpu.stop_etl()
+
+
+def _crash(handle):
+    """Simulate a crash: kill WITHOUT marking intentional → head restarts it."""
+    handle.kill(no_restart=False)
+
+
+def test_executor_crash_restarts_and_queries_work(session):
+    df = session.range(1000, num_partitions=4).with_column("x", F.col("id") * 2)
+    assert df.count() == 1000
+
+    victim = session.executors[0]
+    _crash(victim)
+
+    # next query succeeds (planner waits for respawn / retries on peers)
+    assert df.count() == 1000
+    assert df.filter(F.col("x") >= 1000).count() == 500
+
+    deadline = time.monotonic() + 30
+    while time.monotonic() < deadline:
+        if victim.state() == ActorState.ALIVE:
+            break
+        time.sleep(0.1)
+    assert victim.state() == ActorState.ALIVE
+
+
+def test_blocks_survive_crash_not_intentional_stop(session):
+    ds = dataframe_to_dataset(
+        session.range(500, num_partitions=2).with_column("y", F.col("id") + 1)
+    )
+    assert ds.count() == 500
+
+    for handle in session.executors:
+        _crash(handle)
+    time.sleep(0.5)
+    # crash: owners restart, shm persists → data still readable
+    assert ds.to_arrow().num_rows == 500
+
+
+def test_crash_during_query_retries_tasks(session):
+    """Kill an executor while a query is in flight: task retry on a peer."""
+    import threading
+
+    df = session.range(200_000, num_partitions=8).with_column(
+        "k", F.col("id") % 10
+    )
+    victim = session.executors[0]
+
+    def killer():
+        time.sleep(0.15)
+        _crash(victim)
+
+    thread = threading.Thread(target=killer)
+    thread.start()
+    try:
+        out = df.group_by("k").count().sort("k").collect()
+    finally:
+        thread.join()
+    assert sum(r["count"] for r in out) == 200_000
+
+
+def test_recoverable_dataset_after_total_loss(session):
+    df = session.range(300, num_partitions=3).with_column(
+        "v", F.col("id") * 3
+    ).cache()
+    ds = from_etl_recoverable(df)
+    expected = ds.to_arrow().sort_by("id").column("v").to_pylist()
+
+    from raydp_tpu.store import object_store as store
+
+    store.delete(ds.blocks)
+    recovered = ds.to_arrow().sort_by("id").column("v").to_pylist()
+    assert recovered == expected

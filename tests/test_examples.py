@@ -1,0 +1,56 @@
+"""Examples run as integration tests — the reference's CI pattern
+(.github/workflows/raydp.yml:100-120 runs every example after the unit suite).
+Scaled down via EXAMPLE_ROWS/EXAMPLE_EPOCHS."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run_example(name: str, timeout: int = 420, extra_env=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(
+        [ROOT] + env.get("PYTHONPATH", "").split(os.pathsep)
+    )
+    env["EXAMPLE_ROWS"] = "5000"
+    env["EXAMPLE_EPOCHS"] = "2"
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    env.update(extra_env or {})
+    out = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "examples", name)],
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        env=env,
+        cwd=ROOT,
+    )
+    assert out.returncode == 0, f"{name} failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def test_nyctaxi_example():
+    stdout = _run_example("nyctaxi_jax.py")
+    assert "train_loss" in stdout
+
+
+def test_dlrm_example():
+    stdout = _run_example("dlrm_criteo.py")
+    assert "train_loss" in stdout
+
+
+def test_spmd_job_example():
+    stdout = _run_example("spmd_job_example.py", timeout=180)
+    assert "hello from rank 3/4" in stdout
+    assert "sum over ranks:" in stdout
+
+
+def test_long_context_lm_example():
+    stdout = _run_example("long_context_lm.py", timeout=420)
+    assert "step 4" in stdout
