@@ -342,12 +342,10 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             params = jax.device_put(
                 restored["params"], jax.tree.map(lambda p: p.sharding, params)
             )
-            if "opt_state" in restored:  # exact resume incl. optimizer moments
-                # leave uncommitted: jit places leaves to match params (the
-                # live opt_state's scalar leaves are uncommitted too)
-                opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
-            else:
-                opt_state = tx.init(params)
+            # exact resume incl. optimizer moments; leave uncommitted — jit
+            # places leaves to match params (the live opt_state's scalar
+            # leaves are uncommitted too)
+            opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
             start_epoch = self.resume_from_epoch + 1
 
         import contextlib
@@ -505,7 +503,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
     # checkpointing (orbax; reference uses AIR Checkpoint dicts :243-250)
     # ------------------------------------------------------------------
 
-    def _save_checkpoint(self, params, epoch: int, opt_state=None) -> None:
+    def _save_checkpoint(self, params, epoch: int, opt_state) -> None:
         """Full training state (params + optimizer state) via orbax — exact
         step-level resume, strictly stronger than the reference's model-only
         AIR checkpoints (torch/estimator.py:243-250)."""
@@ -513,9 +511,10 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         import orbax.checkpoint as ocp
 
         path = os.path.join(os.path.abspath(self.checkpoint_dir), f"epoch_{epoch}")
-        state = {"params": jax.device_get(params)}
-        if opt_state is not None:
-            state["opt_state"] = jax.device_get(opt_state)
+        state = {
+            "params": jax.device_get(params),
+            "opt_state": jax.device_get(opt_state),
+        }
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(path, state, force=True)
 

@@ -28,6 +28,19 @@ from raydp_tpu.parallel.ring_attention import (
 def _attend(q, k, v, *, impl: str, axis: str, causal: bool):
     if impl == "full":
         return full_attention(q, k, v, causal=causal)
+    if impl == "flash":
+        from raydp_tpu.ops.flash_attention import flash_attention
+
+        # pick the largest power-of-two block dividing T (kernel requires
+        # exact tiling; "full"/"ring" have no such restriction)
+        def _block(t):
+            for b in (128, 64, 32, 16, 8, 4, 2, 1):
+                if t % b == 0:
+                    return b
+
+        return flash_attention(
+            q, k, v, causal, _block(q.shape[2]), _block(k.shape[2])
+        )
     if impl == "ring":
         return ring_attention(q, k, v, axis_name=axis, causal=causal)
     if impl == "ulysses":
@@ -74,7 +87,7 @@ class TransformerLM(nn.Module):
     num_heads: int = 8
     num_layers: int = 4
     max_len: int = 8192
-    attn_impl: str = "full"  # "full" | "ring" | "ulysses"
+    attn_impl: str = "full"  # "full" | "flash" | "ring" | "ulysses"
     seq_axis: str = "sp"
     dtype: jnp.dtype = jnp.bfloat16
     remat: bool = False

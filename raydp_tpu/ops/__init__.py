@@ -4,11 +4,13 @@ from raydp_tpu.ops.embedding import (
     embedding_lookup_vocab_sharded,
     sharded_embedding_lookup,
 )
+from raydp_tpu.ops.flash_attention import flash_attention
 from raydp_tpu.ops.interaction import dot_interaction, dot_interaction_pallas
 
 __all__ = [
     "dot_interaction",
     "dot_interaction_pallas",
+    "flash_attention",
     "embedding_lookup_vocab_sharded",
     "sharded_embedding_lookup",
 ]

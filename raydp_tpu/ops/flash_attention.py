@@ -1,0 +1,176 @@
+"""Flash attention as a pallas TPU kernel.
+
+Blockwise attention with online-softmax accumulators held in VMEM scratch:
+the grid iterates (batch·head, q-block, k-block) with the k-block axis
+innermost, so the per-q-block statistics (running max m, denominator l,
+unnormalized output o) persist across k iterations and the full [T, T] score
+matrix never materializes — O(T) memory instead of O(T²). Scores run on the
+MXU (`preferred_element_type=f32`); masking and the softmax update run on the
+VPU.
+
+Composes with the sequence-parallel layer: ring attention's per-device block
+product (parallel/ring_attention._block_attn) is exactly one (q-block,
+k-block) tile of this kernel, so ``flash_attention`` is the single-device /
+per-shard compute path and the ring provides the cross-device reduction.
+
+Backward: gradients recompute through the exact jnp reference (attention
+gradients via autodiff of the stable softmax) — the standard
+recompute-in-backward trade; fine for the sequence lengths a single device
+holds.
+
+Off-TPU the same kernel runs in interpret mode, so CPU-mesh tests exercise
+the identical code path.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+
+NEG_INF = -1e30
+
+
+def _flash_kernel(
+    q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *, scale, causal,
+    block_q, block_k,
+):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, NEG_INF)
+        l_acc[:] = jnp.zeros_like(l_acc)
+
+    # causal: a k-block entirely in the future contributes nothing — skip its
+    # matmul + update outright (~2x causal throughput)
+    block_live = (
+        qi * block_q + block_q - 1 >= ki * block_k if causal else ki >= 0
+    )
+
+    @pl.when(block_live)
+    def _accumulate():
+        q = q_ref[0]  # [BQ, D]
+        k = k_ref[0]  # [BK, D]
+        v = v_ref[0]  # [BK, D]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK]
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+
+        m_prev = m_acc[:, :1]  # [BQ, 1] (stats broadcast across lanes)
+        l_prev = l_acc[:, :1]
+        block_max = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, block_max)
+        p = jnp.exp(scores - m_new)  # rows that are all -inf give p == 0
+        if causal:
+            p = jnp.where(scores > NEG_INF / 2, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        o_new = alpha * o_acc[:] + jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+        o_acc[:] = o_new
+        m_acc[:] = jnp.broadcast_to(m_new, m_acc.shape)
+        l_acc[:] = jnp.broadcast_to(l_new, l_acc.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        o_ref[0] = (o_acc[:] / jnp.maximum(l_acc[:, :1], 1e-30)).astype(o_ref.dtype)
+
+
+def _flash_forward(
+    q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool | None
+):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    if t % block_q or tk % block_k:
+        raise ValueError(
+            f"sequence lengths ({t}, {tk}) must divide blocks ({block_q}, {block_k})"
+        )
+    bh = b * h
+    qf = q.reshape(bh, t, d)
+    kf = k.reshape(bh, tk, d)
+    vf = v.reshape(bh, tk, d)
+    scale = d**-0.5
+
+    kernel = functools.partial(
+        _flash_kernel,
+        scale=scale,
+        causal=causal,
+        block_q=block_q,
+        block_k=block_k,
+    )
+    out = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        grid=(bh, t // block_q, tk // block_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qf, kf, vf)
+    return out.reshape(b, h, t, d)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def flash_attention(
+    q, k, v, causal: bool = False, block_q: int = 128, block_k: int = 128,
+    interpret: bool | None = None,
+):
+    """Fused attention: q,k,v [B, H, T, D] → [B, H, T, D]."""
+    return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+
+
+def _reference(q, k, v, causal):
+    scale = q.shape[-1] ** -0.5
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
+    if causal:
+        tq, tk = scores.shape[-2:]
+        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
+        scores = jnp.where(mask, scores, NEG_INF)
+    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+
+
+def _fwd(q, k, v, causal, block_q, block_k, interpret):
+    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
+    return out, (q, k, v)
+
+
+def _bwd(causal, block_q, block_k, interpret, residuals, g):
+    q, k, v = residuals
+    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, causal), q, k, v)
+    return vjp(g)
+
+
+flash_attention.defvjp(_fwd, _bwd)
