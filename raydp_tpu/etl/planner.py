@@ -51,6 +51,14 @@ class Planner:
         self.executors = list(executors or [])
         self.default_parallelism = max(1, default_parallelism)
         self.owner = owner  # ownership target for produced blocks
+        # observability: rolling stats of the most recent query (SURVEY §5:
+        # first-class step timing; the reference defers everything to the
+        # Ray/Spark dashboards). Stage logs are thread-local so concurrent
+        # queries on one session don't interleave each other's stages.
+        import threading
+
+        self.last_query_stats: dict = {}
+        self._tls = threading.local()
 
     # ------------------------------------------------------------------
     # task submission
@@ -77,9 +85,27 @@ class Planner:
         restart, so transient deaths must not fail the query). Only connection
         breakage retries: timeouts and remote application errors propagate
         (a slow task re-executed elsewhere would duplicate side effects)."""
-        if not self.executors:
-            return [T.run_task(s) for s in specs]
-        futures = [(self._dispatch(spec, i, 0), spec, i) for i, spec in enumerate(specs)]
+        import time
+
+        stage_start = time.perf_counter()
+        try:
+            if not self.executors:
+                return [T.run_task(s) for s in specs]
+            futures = [
+                (self._dispatch(spec, i, 0), spec, i) for i, spec in enumerate(specs)
+            ]
+            return self._gather(futures, specs)
+        finally:
+            log = getattr(self._tls, "stage_log", None)
+            if log is not None:
+                log.append(
+                    {
+                        "tasks": len(specs),
+                        "seconds": time.perf_counter() - stage_start,
+                    }
+                )
+
+    def _gather(self, futures, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
         results: List[Optional[T.TaskResult]] = [None] * len(specs)
         for attempt in range(self.MAX_TASK_RETRIES + 1):
             retry: List[Tuple[Any, T.TaskSpec, int]] = []
@@ -231,7 +257,9 @@ class Planner:
 
     def materialize(self, node: lp.PlanNode) -> Materialized:
         """Execute to object-store blocks (one per partition)."""
-        results = self._execute(node, T.OutputSpec("block", owner=self.owner))
+        results = self._instrumented(
+            lambda: self._execute(node, T.OutputSpec("block", owner=self.owner))
+        )
         schema = self.infer_schema(node)
         blocks = [r.blocks[0] if r.blocks else None for r in results]
         counts = [r.num_rows[0] if r.num_rows else 0 for r in results]
@@ -239,7 +267,27 @@ class Planner:
 
     def execute_action(self, node: lp.PlanNode, output: T.OutputSpec) -> List[T.TaskResult]:
         """Run the plan with a custom terminal output (count/inline/parquet)."""
-        return self._execute(node, output)
+        return self._instrumented(lambda: self._execute(node, output))
+
+    def _instrumented(self, run):
+        import time
+
+        if getattr(self._tls, "stage_log", None) is not None:
+            return run()  # nested (e.g. sort materializing its child):
+            # stages contribute to the enclosing query's stats
+        start = time.perf_counter()
+        self._tls.stage_log = []
+        try:
+            results = run()
+        finally:
+            stages = self._tls.stage_log
+            self._tls.stage_log = None
+        self.last_query_stats = {
+            "seconds": time.perf_counter() - start,
+            "output_partitions": len(results),
+            "stages": stages,
+        }
+        return results
 
     # ------------------------------------------------------------------
     # the recursive stage driver

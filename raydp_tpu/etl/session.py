@@ -208,6 +208,12 @@ class EtlSession:
         groups = _group_files(files, num_partitions or self.default_parallelism)
         return DataFrame(self, lp.CsvSource(groups, options))
 
+    @property
+    def last_query_stats(self) -> dict:
+        """Wall time, output partitions, and per-stage task counts/timings of
+        the most recent action (first-class step timing, SURVEY §5)."""
+        return self._planner.last_query_stats
+
     # ------------------------------------------------------------------
     # dynamic allocation (reference doRequestTotalExecutors/doKillExecutors,
     # RayCoarseGrainedSchedulerBackend.scala:229-252)

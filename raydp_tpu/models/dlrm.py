@@ -18,7 +18,7 @@ floats by the exchange layer, cast back to int32 here).
 
 from __future__ import annotations
 
-from typing import Sequence
+from typing import Optional, Sequence
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -32,7 +32,7 @@ class DLRM(nn.Module):
     embed_dim: int = 16
     bottom_mlp: Sequence[int] = (64, 32)
     top_mlp: Sequence[int] = (64, 32)
-    use_pallas_interaction: bool = False
+    use_pallas_interaction: Optional[bool] = None  # None = pallas on TPU
     dtype: jnp.dtype = jnp.float32
 
     @nn.compact
@@ -61,11 +61,12 @@ class DLRM(nn.Module):
             stacked.append(rows)
         t = jnp.stack(stacked, axis=1)  # [B, 1+S, D]
 
-        interact = (
-            dot_interaction_pallas(t)
-            if self.use_pallas_interaction
-            else dot_interaction(t)
-        )
+        use_pallas = self.use_pallas_interaction
+        if use_pallas is None:
+            import jax
+
+            use_pallas = jax.default_backend() == "tpu"
+        interact = dot_interaction_pallas(t) if use_pallas else dot_interaction(t)
         z = jnp.concatenate([h, interact.astype(self.dtype)], axis=1)
 
         for width in self.top_mlp:

@@ -153,13 +153,11 @@ def flash_attention(
 
 
 def _reference(q, k, v, causal):
-    scale = q.shape[-1] ** -0.5
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) * scale
-    if causal:
-        tq, tk = scores.shape[-2:]
-        mask = jnp.arange(tq)[:, None] >= jnp.arange(tk)[None, :]
-        scores = jnp.where(mask, scores, NEG_INF)
-    return jnp.einsum("bhqk,bhkd->bhqd", jax.nn.softmax(scores, -1), v)
+    # single source of truth for exact attention (gradients recompute
+    # through this, so it must stay in lockstep with the parallel layer)
+    from raydp_tpu.parallel.ring_attention import full_attention
+
+    return full_attention(q, k, v, causal=causal)
 
 
 def _fwd(q, k, v, causal, block_q, block_k, interpret):

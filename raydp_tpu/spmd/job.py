@@ -37,19 +37,15 @@ class WorkerContext:
 
 
 class SpmdWorker:
-    """One rank: executes shipped functions in submission order."""
+    """One rank: executes shipped functions in submission order. The job env
+    (incl. rank/world vars) arrives via the actor's process environment —
+    set at spawn so interpreter-startup consumers (JAX platform selection)
+    see it; nothing is re-applied here."""
 
-    def __init__(self, job_name: str, rank: int, world_size: int,
-                 env: Optional[Dict[str, str]] = None):
-        import os
-
+    def __init__(self, job_name: str, rank: int, world_size: int):
         self.ctx = WorkerContext(job_name, rank, world_size)
         self._next_func_id = 0
         self._lock = threading.Lock()
-        os.environ["RAYDP_TPU_SPMD_RANK"] = str(rank)
-        os.environ["RAYDP_TPU_SPMD_WORLD_SIZE"] = str(world_size)
-        for key, value in (env or {}).items():
-            os.environ[key] = value
 
     def ping(self) -> int:
         return self.ctx.rank
@@ -59,8 +55,17 @@ class SpmdWorker:
     ) -> int:
         """Join the jax.distributed mesh (the reference's analog: each mpi
         rank joins Ray via ray.init(address), mpi_worker.py:158-166)."""
+        import os
+
         import jax
 
+        # honor a CPU request even if the image pre-imports jax with a TPU
+        # plugin registered (config must be set before backend init)
+        if os.environ.get("JAX_PLATFORMS", "").lower() == "cpu":
+            try:
+                jax.config.update("jax_platforms", "cpu")
+            except Exception:
+                pass
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -151,13 +156,20 @@ class SpmdJob:
                         self.job_name,
                         rank,
                         self.world_size,
-                        self.env,
                         name=f"{self.job_name}-rank-{rank}",
                         num_cpus=self.num_cpus_per_worker,
                         placement_group=self._pg.id,
                         bundle_index=indexes[rank % len(indexes)],
                         max_restarts=0,
                         max_concurrency=2,
+                        # env must be in place at process start: platform
+                        # selection (JAX_PLATFORMS/XLA_FLAGS) is read during
+                        # interpreter startup, before __init__ runs
+                        env={
+                            **self.env,
+                            "RAYDP_TPU_SPMD_RANK": str(rank),
+                            "RAYDP_TPU_SPMD_WORLD_SIZE": str(self.world_size),
+                        },
                         block=False,
                     )
                     self._workers.append(handle)

@@ -119,6 +119,55 @@ def test_placement_group_strategies(strategy):
     assert len(cluster.placement_group_table()) == before
 
 
+def test_query_stats():
+    session = raydp_tpu.init_etl(
+        "stats", num_executors=1, executor_cores=1, executor_memory="200M"
+    )
+    try:
+        df = session.range(1000, num_partitions=4).with_column("k", F.col("id") % 3)
+        assert df.group_by("k").count().count() == 3
+        stats = session.last_query_stats
+        assert stats["seconds"] > 0
+        assert stats["output_partitions"] >= 1
+        assert len(stats["stages"]) >= 2  # map + reduce
+        assert all(s["tasks"] >= 1 for s in stats["stages"])
+    finally:
+        raydp_tpu.stop_etl()
+
+
+def test_concurrent_queries_one_session():
+    """Multiple threads driving the same session concurrently (the reference's
+    thread-safety-by-construction claim, SURVEY §5)."""
+    import threading
+
+    session = raydp_tpu.init_etl(
+        "concurrent", num_executors=2, executor_cores=2, executor_memory="200M"
+    )
+    errors = []
+
+    def worker(seed):
+        try:
+            df = session.range(2000, num_partitions=4).with_column(
+                "k", F.col("id") % (seed + 2)
+            )
+            total = sum(
+                r["count"] for r in df.group_by("k").count().collect()
+            )
+            assert total == 2000, total
+        except Exception as exc:  # noqa: BLE001
+            errors.append(exc)
+
+    try:
+        threads = [threading.Thread(target=worker, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert not errors, errors
+    finally:
+        raydp_tpu.stop_etl()
+
+
 def test_fractional_executor_cpu():
     """Reference spark_on_ray_fractional_cpu (conftest.py:76-87): actor CPU
     decoupled from task parallelism via etl.actor.resource.cpu."""
