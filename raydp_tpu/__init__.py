@@ -28,6 +28,8 @@ _LAZY = {
     "from_etl_recoverable": ("raydp_tpu.exchange.dataset", "from_etl_recoverable"),
     "Dataset": ("raydp_tpu.exchange.dataset", "Dataset"),
     "create_spmd_job": ("raydp_tpu.spmd.job", "create_spmd_job"),
+    "MLDataset": ("raydp_tpu.exchange.ml_dataset", "MLDataset"),
+    "JaxEstimator": ("raydp_tpu.estimator.jax_estimator", "JaxEstimator"),
 }
 
 

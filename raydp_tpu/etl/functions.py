@@ -143,7 +143,16 @@ def length(c: ColumnLike) -> Expr:
 
 
 def concat(*cols: ColumnLike) -> Expr:
-    return Function("binary_join_element_wise", [_c(c) for c in cols] + [Literal("")])
+    # normalize to one string type: arrow's join kernel rejects mixed
+    # string/large_string inputs (pandas produces large_string columns)
+    import pyarrow as pa
+
+    from raydp_tpu.etl.expressions import Cast
+
+    normalized = [Cast(_c(c), pa.large_string()) for c in cols]
+    return Function(
+        "binary_join_element_wise", normalized + [Cast(Literal(""), pa.large_string())]
+    )
 
 
 # -- datetime (NYCTaxi feature engineering uses these heavily) ---------------

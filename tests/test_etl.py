@@ -254,6 +254,65 @@ def test_num_partitions_structural(session):
     assert df.union(df).num_partitions() == 10
 
 
+def test_function_coverage(session):
+    """Broad sweep over the F namespace against known values."""
+    pdf = pd.DataFrame(
+        {
+            "s": ["  Hello ", "WORLD", "a", ""],
+            "x": [1.5, -2.5, 0.0, 9.0],
+            "n": [1.0, None, 3.0, None],
+            "t": pd.to_datetime(
+                ["2021-03-14 15:09:26", "2020-12-31 23:59:59",
+                 "2021-01-01 00:00:00", "2021-06-15 12:00:00"]
+            ),
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=2)
+    out = df.select(
+        F.trim(F.lower("s")).alias("ls"),
+        F.length("s").alias("len"),
+        F.abs("x").alias("ax"),
+        F.round(F.sqrt(F.abs("x")), 2).alias("rs"),
+        F.coalesce("n", F.lit(-1.0)).alias("cn"),
+        F.year("t").alias("yr"),
+        F.month("t").alias("mo"),
+        F.dayofmonth("t").alias("dom"),
+        F.hour("t").alias("hr"),
+        F.minute("t").alias("mi"),
+        F.concat(F.lit("<"), F.trim("s"), F.lit(">")).alias("cc"),
+        F.unix_timestamp("t").alias("ts"),
+    ).to_arrow().sort_by("yr")
+    rows = {r["cc"]: r for r in out.to_pylist()}
+    hello = rows["<Hello>"]
+    assert hello["ls"] == "hello"
+    assert hello["len"] == 8
+    assert hello["yr"] == 2021 and hello["mo"] == 3 and hello["dom"] == 14
+    assert hello["hr"] == 15 and hello["mi"] == 9
+    assert hello["ts"] == int(pd.Timestamp("2021-03-14 15:09:26").value // 10**9)
+    assert rows["<WORLD>"]["cn"] == -1.0
+    assert rows["<WORLD>"]["ax"] == 2.5
+
+
+def test_expression_methods(session):
+    df = session.range(10, num_partitions=2).with_column(
+        "s", F.when(F.col("id") < 5, "abcdef").otherwise("xyz")
+    )
+    out = df.select(
+        F.col("id").between(3, 6).alias("b"),
+        F.col("id").isin(1, 2, 9).alias("i"),
+        (-F.col("id")).alias("neg"),
+        (~(F.col("id") > 5)).alias("note"),
+        F.col("s").substr(2, 3).alias("sub"),
+        F.col("id").cast("float32").alias("f"),
+    ).to_arrow()
+    rows = out.to_pylist()
+    assert [r["b"] for r in rows] == [3 <= i <= 6 for i in range(10)]
+    assert [r["i"] for r in rows] == [i in (1, 2, 9) for i in range(10)]
+    assert rows[4]["sub"] == "bcd" and rows[7]["sub"] == "yz"
+    assert rows[3]["neg"] == -3
+    assert str(out.schema.field("f").type) == "float"
+
+
 def test_schema_inference_matches_execution(session):
     df = (
         session.range(10, num_partitions=2)
