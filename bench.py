@@ -110,11 +110,49 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     return trained, t_etl, t_train, est.compile_seconds_
 
 
-def bench_pure_jax(n_rows: int, batch: int, epochs: int):
-    """Pure-JAX loop on pre-staged numpy — the throughput ceiling proxy."""
+
+def pure_jax_throughput(model, loss_fn, x, y, batch: int, epochs: int) -> float:
+    """Shared pure-JAX baseline: jit step + adam, warm compile, timed epochs.
+    Returns samples/sec — the throughput ceiling proxy both workloads compare
+    against (one copy so the timing methodology can't drift between them)."""
     import jax
     import jax.numpy as jnp
     import optax
+
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.asarray(x[:batch]))
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+
+    @jax.jit
+    def step(params, opt_state, xb, yb):
+        def compute(p):
+            return loss_fn(model.apply(p, xb), yb)
+
+        loss, grads = jax.value_and_grad(compute)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    params, opt_state, _ = step(
+        params, opt_state, jnp.asarray(x[:batch]), jnp.asarray(y[:batch])
+    )
+    jax.block_until_ready(params)
+    n_rows = len(x)
+    steps_per_epoch = n_rows // batch
+    order = np.arange(n_rows)
+    t0 = time.perf_counter()
+    for epoch in range(epochs):
+        np.random.default_rng(epoch).shuffle(order)
+        for s in range(steps_per_epoch):
+            idx = order[s * batch : (s + 1) * batch]
+            params, opt_state, _ = step(
+                params, opt_state, jnp.asarray(x[idx]), jnp.asarray(y[idx])
+            )
+    jax.block_until_ready(params)
+    return steps_per_epoch * batch * epochs / (time.perf_counter() - t0)
+
+def bench_pure_jax(n_rows: int, batch: int, epochs: int):
+    """Pure-JAX loop on pre-staged numpy — the throughput ceiling proxy."""
+    import jax.numpy as jnp
 
     from raydp_tpu.models import MLPRegressor
 
@@ -122,39 +160,101 @@ def bench_pure_jax(n_rows: int, batch: int, epochs: int):
     x = rng.random((n_rows, len(FEATURES))).astype(np.float32)
     y = rng.random(n_rows).astype(np.float32)
 
-    model = MLPRegressor()
-    params = model.init(jax.random.PRNGKey(0), jnp.asarray(x[:batch]))
-    tx = optax.adam(1e-3)
-    opt_state = tx.init(params)
+    def mse(pred, target):
+        return jnp.mean((pred.reshape(target.shape) - target) ** 2)
 
-    @jax.jit
-    def step(params, opt_state, xb, yb):
-        def loss_fn(p):
-            pred = model.apply(p, xb)
-            return jnp.mean((pred.reshape(yb.shape) - yb) ** 2)
+    sps = pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs)
+    return (n_rows // batch) * batch * epochs, (n_rows // batch) * batch * epochs / sps
 
-        loss, grads = jax.value_and_grad(loss_fn)(params)
-        updates, opt_state = tx.update(grads, opt_state, params)
-        return optax.apply_updates(params, updates), opt_state, loss
 
-    steps_per_epoch = n_rows // batch
-    # warm the compile so both sides measure steady-state throughput
-    params, opt_state, _ = step(
-        params, opt_state, jnp.asarray(x[:batch]), jnp.asarray(y[:batch])
-    )
-    jax.block_until_ready(params)
+DLRM_VOCABS = [100_000, 10_000, 1_000, 1_000, 100, 100]
+DLRM_DENSE = 8
+
+
+def make_criteo_frame(session, n_rows: int, parts: int):
+    import pandas as pd
+
+    from raydp_tpu.etl import functions as F
+
+    rng = np.random.default_rng(11)
+    data = {"label": rng.integers(0, 2, n_rows).astype(np.float32)}
+    for i in range(DLRM_DENSE):
+        data[f"i{i}"] = rng.integers(0, 1000, n_rows).astype(np.float32)
+    for j, vocab in enumerate(DLRM_VOCABS):
+        data[f"c{j}"] = rng.integers(0, vocab, n_rows).astype(np.int64)
+    df = session.from_pandas(pd.DataFrame(data), num_partitions=parts)
+    for i in range(DLRM_DENSE):
+        df = df.with_column(f"i{i}", F.log1p(F.col(f"i{i}")).cast("float32"))
+    for j, vocab in enumerate(DLRM_VOCABS):
+        df = df.with_column(f"c{j}", F.hash(f"c{j}", vocab).cast("float32"))
+    return df
+
+
+def bench_dlrm(n_rows: int, batch: int, epochs: int):
+    """DLRM/Criteo end-to-end (the BASELINE.json headline workload)."""
+    import raydp_tpu
+    from raydp_tpu.estimator import JaxEstimator
+    from raydp_tpu.exchange import dataframe_to_dataset
+    from raydp_tpu.models import DLRM
+
+    features = [f"i{i}" for i in range(DLRM_DENSE)] + [
+        f"c{j}" for j in range(len(DLRM_VOCABS))
+    ]
     t0 = time.perf_counter()
-    order = np.arange(n_rows)
-    for epoch in range(epochs):
-        np.random.default_rng(epoch).shuffle(order)
-        for s in range(steps_per_epoch):
-            idx = order[s * batch : (s + 1) * batch]
-            params, opt_state, loss = step(
-                params, opt_state, jnp.asarray(x[idx]), jnp.asarray(y[idx])
-            )
-    jax.block_until_ready(params)
-    elapsed = time.perf_counter() - t0
-    return steps_per_epoch * batch * epochs, elapsed
+    session = raydp_tpu.init_etl(
+        "bench-dlrm", num_executors=2, executor_cores=2, executor_memory="1G"
+    )
+    df = make_criteo_frame(session, n_rows, parts=8)
+    ds = dataframe_to_dataset(df)
+    t_etl = time.perf_counter() - t0
+
+    model = DLRM(
+        vocab_sizes=DLRM_VOCABS, num_dense=DLRM_DENSE, embed_dim=16,
+        bottom_mlp=(128, 64), top_mlp=(128, 64),
+    )
+    est = JaxEstimator(
+        model=model, optimizer="adam", loss="bce",
+        feature_columns=features, label_column="label",
+        batch_size=batch, num_epochs=epochs, learning_rate=1e-3, seed=0,
+    )
+    t1 = time.perf_counter()
+    est.fit(ds)
+    t_train = time.perf_counter() - t1 - est.compile_seconds_
+    raydp_tpu.stop_etl()
+    trained = (n_rows // batch) * batch * epochs
+
+    # pure-JAX baseline via the shared helper
+    import jax.numpy as jnp
+    import optax
+
+    rng = np.random.default_rng(11)
+    x = np.concatenate(
+        [rng.random((n_rows, DLRM_DENSE)).astype(np.float32)]
+        + [
+            rng.integers(0, v, (n_rows, 1)).astype(np.float32)
+            for v in DLRM_VOCABS
+        ],
+        axis=1,
+    )
+    y = rng.integers(0, 2, n_rows).astype(np.float32)
+
+    def bce(pred, target):
+        return jnp.mean(
+            optax.sigmoid_binary_cross_entropy(pred.reshape(target.shape), target)
+        )
+
+    pure_sps = pure_jax_throughput(model, bce, x, y, batch, epochs)
+
+    return {
+        "etl_s": round(t_etl, 2),
+        "train_s": round(t_train, 2),
+        "compile_s": round(est.compile_seconds_, 2),
+        "e2e_sps": round(trained / (t_etl + t_train), 1),
+        "train_only_sps": round(trained / t_train, 1),
+        "pure_jax_sps": round(pure_sps, 1),
+        "vs_baseline": round((trained / t_train) / pure_sps, 4),
+        "rows": n_rows,
+    }
 
 
 def main():
@@ -168,6 +268,12 @@ def main():
 
     base_trained, base_time = bench_pure_jax(n_rows, batch, epochs)
     baseline_sps = base_trained / base_time
+
+    dlrm = bench_dlrm(
+        int(os.environ.get("BENCH_DLRM_ROWS", 100_000)),
+        int(os.environ.get("BENCH_DLRM_BATCH", 2048)),
+        int(os.environ.get("BENCH_DLRM_EPOCHS", 2)),
+    )
 
     result = {
         "metric": "nyctaxi_mlp_e2e",
@@ -184,6 +290,7 @@ def main():
             "rows": n_rows,
             "batch": batch,
             "epochs": epochs,
+            "dlrm": dlrm,
         },
     }
     print(json.dumps(result))

@@ -60,6 +60,19 @@ class Planner:
         self.last_query_stats: dict = {}
         self._tls = threading.local()
 
+    def __getstate__(self):
+        # planners travel inside pickled sessions (Dataset._session → workers);
+        # thread-local state is process-private and recreated on arrival
+        state = dict(self.__dict__)
+        state.pop("_tls", None)
+        return state
+
+    def __setstate__(self, state):
+        import threading
+
+        self.__dict__.update(state)
+        self._tls = threading.local()
+
     # ------------------------------------------------------------------
     # task submission
     # ------------------------------------------------------------------

@@ -30,24 +30,28 @@ def moe_apply(
     capacity_factor: float = 1.25,
 ) -> jnp.ndarray:
     """Call inside shard_map. ``expert_params`` is THIS device's expert."""
+    import math
+
     n = lax.axis_size(axis_name)
     b, d = x.shape
-    capacity = max(1, int(b * capacity_factor / n))  # per (device, expert)
+    # ceil keeps the requested headroom even at small per-device batches
+    capacity = max(1, math.ceil(b * capacity_factor / n))  # per (device, expert)
 
     logits = x @ router_weights  # [B, N]
     gates = jax.nn.softmax(logits, axis=-1)
     assign = jnp.argmax(gates, axis=-1)  # [B]
     gate = jnp.take_along_axis(gates, assign[:, None], axis=1)[:, 0]  # [B]
 
-    one_hot = jax.nn.one_hot(assign, n, dtype=x.dtype)  # [B, N]
-    # slot of each token within its expert's buffer (order of arrival)
-    pos = (jnp.cumsum(one_hot, axis=0) - 1.0) * one_hot  # [B, N]
+    # slot bookkeeping in f32 regardless of x.dtype: a bf16 cumsum saturates
+    # at 256 and silently collides capacity slots
+    one_hot_f32 = jax.nn.one_hot(assign, n, dtype=jnp.float32)  # [B, N]
+    pos = (jnp.cumsum(one_hot_f32, axis=0) - 1.0) * one_hot_f32  # [B, N]
     in_capacity = pos < capacity
-    dispatch_mask = one_hot * in_capacity  # [B, N]
+    dispatch_mask = one_hot_f32 * in_capacity  # [B, N]
     slot_one_hot = jax.nn.one_hot(
-        pos.astype(jnp.int32), capacity, dtype=x.dtype
+        pos.astype(jnp.int32), capacity, dtype=jnp.float32
     )  # [B, N, C]
-    dispatch = slot_one_hot * dispatch_mask[:, :, None]  # [B, N, C]
+    dispatch = (slot_one_hot * dispatch_mask[:, :, None]).astype(x.dtype)
 
     # local per-expert buffers [N, C, D] → ship buffer e to device e; the
     # tiled all_to_all splits the expert dim across devices and concatenates
