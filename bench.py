@@ -87,7 +87,10 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
         "bench", num_executors=2, executor_cores=2, executor_memory="1G"
     )
     df = make_taxi_frame(session, n_rows, parts=8)
-    ds = dataframe_to_dataset(df)
+    # ownership transfer + stop: training runs with the ETL engine's CPUs
+    # returned (the reference's stop_spark_after_conversion pattern)
+    ds = dataframe_to_dataset(df, _use_owner=True)
+    raydp_tpu.stop_etl(cleanup_data=False, del_obj_holder=False)
     t_etl = time.perf_counter() - t0
 
     est = JaxEstimator(
@@ -102,14 +105,27 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
         shuffle=True,
         seed=0,
     )
-    t1 = time.perf_counter()
-    est.fit(ds)
-    t_train = time.perf_counter() - t1 - est.compile_seconds_
-    raydp_tpu.stop_etl()
+    compiles = []
+
+    def one_fit():
+        t1 = time.perf_counter()
+        est.fit(ds)
+        compiles.append(est.compile_seconds_)
+        return time.perf_counter() - t1 - est.compile_seconds_
+
+    t_train, _ = best_of(2, one_fit)
     trained = (n_rows // batch) * batch * epochs
-    return trained, t_etl, t_train, est.compile_seconds_
+    return trained, t_etl, t_train, max(compiles)
 
 
+
+
+def best_of(n_samples: int, fn):
+    """Run fn() n times, return (best_value, all_values) by minimum.
+    The TPU tunnel's throughput is volatile run-to-run, so every timed side
+    of the comparison samples the same way."""
+    values = [fn() for _ in range(n_samples)]
+    return min(values), values
 
 def pure_jax_throughput(model, loss_fn, x, y, batch: int, epochs: int) -> float:
     """Shared pure-JAX baseline: jit step + adam, warm compile, timed epochs.
@@ -163,7 +179,8 @@ def bench_pure_jax(n_rows: int, batch: int, epochs: int):
     def mse(pred, target):
         return jnp.mean((pred.reshape(target.shape) - target) ** 2)
 
-    sps = pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs)
+    neg_sps, _ = best_of(2, lambda: -pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs))
+    sps = -neg_sps
     return (n_rows // batch) * batch * epochs, (n_rows // batch) * batch * epochs / sps
 
 
@@ -205,7 +222,8 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
         "bench-dlrm", num_executors=2, executor_cores=2, executor_memory="1G"
     )
     df = make_criteo_frame(session, n_rows, parts=8)
-    ds = dataframe_to_dataset(df)
+    ds = dataframe_to_dataset(df, _use_owner=True)
+    raydp_tpu.stop_etl(cleanup_data=False, del_obj_holder=False)
     t_etl = time.perf_counter() - t0
 
     model = DLRM(
@@ -217,10 +235,15 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
         feature_columns=features, label_column="label",
         batch_size=batch, num_epochs=epochs, learning_rate=1e-3, seed=0,
     )
-    t1 = time.perf_counter()
-    est.fit(ds)
-    t_train = time.perf_counter() - t1 - est.compile_seconds_
-    raydp_tpu.stop_etl()
+    compiles = []
+
+    def one_fit():
+        t1 = time.perf_counter()
+        est.fit(ds)
+        compiles.append(est.compile_seconds_)
+        return time.perf_counter() - t1 - est.compile_seconds_
+
+    t_train, _ = best_of(2, one_fit)
     trained = (n_rows // batch) * batch * epochs
 
     # pure-JAX baseline via the shared helper
@@ -243,12 +266,13 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
             optax.sigmoid_binary_cross_entropy(pred.reshape(target.shape), target)
         )
 
-    pure_sps = pure_jax_throughput(model, bce, x, y, batch, epochs)
+    neg_sps, _ = best_of(2, lambda: -pure_jax_throughput(model, bce, x, y, batch, epochs))
+    pure_sps = -neg_sps
 
     return {
         "etl_s": round(t_etl, 2),
         "train_s": round(t_train, 2),
-        "compile_s": round(est.compile_seconds_, 2),
+        "compile_s": round(max(compiles), 2),
         "e2e_sps": round(trained / (t_etl + t_train), 1),
         "train_only_sps": round(trained / t_train, 1),
         "pure_jax_sps": round(pure_sps, 1),
@@ -268,6 +292,14 @@ def main():
 
     base_trained, base_time = bench_pure_jax(n_rows, batch, epochs)
     baseline_sps = base_trained / base_time
+
+    # free the NYCTaxi session's holder + blocks before the DLRM measurement
+    from raydp_tpu.cluster import api as _cluster
+
+    try:
+        _cluster.get_actor("bench_ETL_MASTER").kill()
+    except Exception:
+        pass
 
     dlrm = bench_dlrm(
         int(os.environ.get("BENCH_DLRM_ROWS", 100_000)),
