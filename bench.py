@@ -105,6 +105,23 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
         shuffle=True,
         seed=0,
     )
+    t_train, compile_s = timed_fit(est, ds)
+    trained = (n_rows // batch) * batch * epochs
+    return trained, t_etl, t_train, compile_s
+
+
+
+
+def best_of(n_samples: int, fn, best=min):
+    """Run fn() n times, return the best value (min for durations, max for
+    throughputs). The TPU tunnel's throughput is volatile run-to-run, so
+    every timed side of the comparison samples the same way."""
+    return best(fn() for _ in range(n_samples))
+
+
+def timed_fit(est, ds, n_samples: int = 2):
+    """Best-of-n wall time of est.fit(ds) excluding measured compile; returns
+    (best_train_seconds, max_compile_seconds)."""
     compiles = []
 
     def one_fit():
@@ -113,19 +130,7 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
         compiles.append(est.compile_seconds_)
         return time.perf_counter() - t1 - est.compile_seconds_
 
-    t_train, _ = best_of(2, one_fit)
-    trained = (n_rows // batch) * batch * epochs
-    return trained, t_etl, t_train, max(compiles)
-
-
-
-
-def best_of(n_samples: int, fn):
-    """Run fn() n times, return (best_value, all_values) by minimum.
-    The TPU tunnel's throughput is volatile run-to-run, so every timed side
-    of the comparison samples the same way."""
-    values = [fn() for _ in range(n_samples)]
-    return min(values), values
+    return best_of(n_samples, one_fit), max(compiles)
 
 def pure_jax_throughput(model, loss_fn, x, y, batch: int, epochs: int) -> float:
     """Shared pure-JAX baseline: jit step + adam, warm compile, timed epochs.
@@ -179,8 +184,7 @@ def bench_pure_jax(n_rows: int, batch: int, epochs: int):
     def mse(pred, target):
         return jnp.mean((pred.reshape(target.shape) - target) ** 2)
 
-    neg_sps, _ = best_of(2, lambda: -pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs))
-    sps = -neg_sps
+    sps = best_of(2, lambda: pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs), best=max)
     return (n_rows // batch) * batch * epochs, (n_rows // batch) * batch * epochs / sps
 
 
@@ -235,15 +239,7 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
         feature_columns=features, label_column="label",
         batch_size=batch, num_epochs=epochs, learning_rate=1e-3, seed=0,
     )
-    compiles = []
-
-    def one_fit():
-        t1 = time.perf_counter()
-        est.fit(ds)
-        compiles.append(est.compile_seconds_)
-        return time.perf_counter() - t1 - est.compile_seconds_
-
-    t_train, _ = best_of(2, one_fit)
+    t_train, compile_s = timed_fit(est, ds)
     trained = (n_rows // batch) * batch * epochs
 
     # pure-JAX baseline via the shared helper
@@ -266,13 +262,12 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
             optax.sigmoid_binary_cross_entropy(pred.reshape(target.shape), target)
         )
 
-    neg_sps, _ = best_of(2, lambda: -pure_jax_throughput(model, bce, x, y, batch, epochs))
-    pure_sps = -neg_sps
+    pure_sps = best_of(2, lambda: pure_jax_throughput(model, bce, x, y, batch, epochs), best=max)
 
     return {
         "etl_s": round(t_etl, 2),
         "train_s": round(t_train, 2),
-        "compile_s": round(max(compiles), 2),
+        "compile_s": round(compile_s, 2),
         "e2e_sps": round(trained / (t_etl + t_train), 1),
         "train_only_sps": round(trained / t_train, 1),
         "pure_jax_sps": round(pure_sps, 1),
@@ -295,11 +290,13 @@ def main():
 
     # free the NYCTaxi session's holder + blocks before the DLRM measurement
     from raydp_tpu.cluster import api as _cluster
+    from raydp_tpu.cluster.common import ClusterError
+    from raydp_tpu.etl.session import MASTER_ACTOR_SUFFIX
 
     try:
-        _cluster.get_actor("bench_ETL_MASTER").kill()
-    except Exception:
-        pass
+        _cluster.get_actor(f"bench{MASTER_ACTOR_SUFFIX}").kill()
+    except ClusterError:
+        pass  # already gone
 
     dlrm = bench_dlrm(
         int(os.environ.get("BENCH_DLRM_ROWS", 100_000)),

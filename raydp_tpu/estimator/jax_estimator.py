@@ -233,13 +233,29 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         return batch
 
     def _stage_host(self, ds) -> "_HostArrays":
-        """Arrow → host numpy exactly once; epochs reshuffle indices only."""
+        """Arrow → host numpy exactly once; epochs reshuffle indices only.
+
+        Multi-process (one process per TPU host): each process stages only its
+        equal-share shard — ``device_put_batch`` then assembles the global
+        batch from per-process rows (make_array_from_process_local_data)."""
+        import jax
+
         features, labels = ds.to_numpy(
             self.feature_columns,
             self.label_column,
             feature_dtype=self.feature_dtype,
             label_dtype=self.label_dtype,
         )
+        p = jax.process_count()
+        if p > 1:
+            # slice this process's equal share in memory (no object-store
+            # round trip); wraparound oversampling keeps counts identical so
+            # every process runs the same step count
+            n = len(features)
+            per = -(-n // p)
+            idx = (np.arange(per) + jax.process_index() * per) % n
+            features = features[idx]
+            labels = labels[idx] if labels is not None else None
         return _HostArrays(features, labels)
 
     # ------------------------------------------------------------------
@@ -397,7 +413,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                         self._evaluate_host(eval_host, params, eval_step, mesh, batch_size)
                     )
                 self._history.append(record)
-                if self.checkpoint_dir:
+                # multi-process: only process 0 writes (concurrent orbax
+                # saves to one path race delete/write/commit)
+                if self.checkpoint_dir and jax.process_index() == 0:
                     self._save_checkpoint(params, epoch, opt_state)
 
         for record in self._history:  # one sync at the end

@@ -296,6 +296,39 @@ class DataFrame:
     def show(self, n: int = 20) -> None:
         print(self.limit(n).to_pandas().to_string())
 
+    def describe(self, *cols: str) -> "DataFrame":
+        """count/mean/min/max per numeric column (Spark describe parity)."""
+        import pyarrow.types as pat
+
+        from raydp_tpu.etl import functions as F
+
+        if cols:
+            unknown = [c for c in cols if c not in self.schema.names]
+            if unknown:
+                raise KeyError(f"describe: unknown columns {unknown}")
+        numeric = [
+            f.name
+            for f in self.schema
+            if (not cols or f.name in cols)
+            and (pat.is_integer(f.type) or pat.is_floating(f.type))
+        ]
+        if not numeric:
+            raise ValueError(
+                "describe: no numeric columns"
+                + (f" among {list(cols)}" if cols else f" in {self.columns}")
+            )
+        aggs = []
+        for c in numeric:
+            aggs.extend(
+                [
+                    F.count(c).alias(f"count({c})"),
+                    F.avg(c).alias(f"mean({c})"),
+                    F.min(c).alias(f"min({c})"),
+                    F.max(c).alias(f"max({c})"),
+                ]
+            )
+        return self.agg(*aggs)
+
     def cache(self) -> "DataFrame":
         """Materialize to object-store blocks and replace the plan with the
         materialized source (Spark .cache parity; blocks die with the session

@@ -254,6 +254,16 @@ def test_num_partitions_structural(session):
     assert df.union(df).num_partitions() == 10
 
 
+def test_describe(session):
+    df = session.range(100, num_partitions=4).with_column(
+        "x", F.col("id").cast("float32") * 2
+    )
+    row = df.describe().collect()[0]
+    assert row["count(id)"] == 100
+    assert row["mean(id)"] == pytest.approx(49.5)
+    assert row["min(x)"] == 0.0 and row["max(x)"] == 198.0
+
+
 def test_function_coverage(session):
     """Broad sweep over the F namespace against known values."""
     pdf = pd.DataFrame(
