@@ -297,6 +297,26 @@ def test_moe_expert_parallel_matches_dense(cpu_mesh_devices):
     np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad), atol=1e-4)
 
 
+def test_quantize_int8_roundtrip():
+    import jax.numpy as jnp
+
+    from raydp_tpu.ops import dequantize_int8, quantize_int8
+
+    rng = np.random.default_rng(12)
+    x = jnp.asarray(rng.standard_normal((32, 128)) * 2, jnp.float32)
+    values, scales = quantize_int8(x)
+    assert values.dtype == jnp.int8 and scales.shape == (32, 1)
+    back = dequantize_int8(values, scales)
+    quantum = float(jnp.max(scales))
+    assert float(jnp.max(jnp.abs(back - x))) <= quantum + 1e-6
+
+    # stochastic path (jax.random off-TPU; the pallas kernel is TPU-only and
+    # validated on real hardware): unbiased
+    sv, ss = quantize_int8(x, seed=3, stochastic=True)
+    sback = dequantize_int8(sv, ss)
+    assert abs(float(jnp.mean(sback - x))) < quantum / 10
+
+
 def test_make_mesh_shapes(cpu_mesh_devices):
     import jax
     from raydp_tpu.parallel import make_mesh, mesh_axis_size
