@@ -127,25 +127,37 @@ class EtlSession:
             self.configs.get("etl.actor.resource.cpu", executor_cores)
         )
         self.executors = []
+        import time as _time
+
         for i in range(num_executors):
             bundle = -1
             if self._pg is not None:
                 indexes = self._bundle_indexes or list(range(num_executors))
                 bundle = indexes[i % len(indexes)]
-            handle = cluster.spawn(
-                EtlExecutor,
-                i,
-                app_name,
-                self.configs,
-                name=f"{app_name}-etl-executor-{i}",
-                num_cpus=actor_cpu,
-                memory=float(self.executor_memory),
-                max_restarts=3,
-                max_concurrency=max(2, executor_cores + 1),
-                placement_group=self._pg.id if self._pg else None,
-                bundle_index=bundle,
-                block=False,
-            )
+            deadline = _time.monotonic() + 15.0
+            while True:
+                try:
+                    handle = cluster.spawn(
+                        EtlExecutor,
+                        i,
+                        app_name,
+                        self.configs,
+                        name=f"{app_name}-etl-executor-{i}",
+                        num_cpus=actor_cpu,
+                        memory=float(self.executor_memory),
+                        max_restarts=3,
+                        max_concurrency=max(2, executor_cores + 1),
+                        placement_group=self._pg.id if self._pg else None,
+                        bundle_index=bundle,
+                        block=False,
+                    )
+                    break
+                except Exception:
+                    # a predecessor session's killed actors may still be
+                    # draining their resources/names; wait briefly
+                    if _time.monotonic() > deadline:
+                        raise
+                    _time.sleep(0.2)
             self.executors.append(handle)
         for handle in self.executors:
             handle.wait_ready()

@@ -123,9 +123,15 @@ def _flash_forward(
         block_q=block_q,
         block_k=block_k,
     )
+    # under shard_map (manual partitioning — the only way Mosaic kernels run
+    # multi-device) the out_shape must carry the inputs' varying-axes set
+    out_sds = jax.ShapeDtypeStruct((bh, t, d), q.dtype)
+    vma = getattr(jax.typeof(qf), "vma", None)
+    if vma:
+        out_sds = jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=vma)
     out = pl.pallas_call(
         kernel,
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q.dtype),
+        out_shape=out_sds,
         grid=(bh, t // block_q, tk // block_k),
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
