@@ -23,12 +23,14 @@ from __future__ import annotations
 import atexit
 import os
 import threading
+import time
 import uuid
 from typing import Any, Dict, List, Optional, Sequence, Union
 
 import pyarrow as pa
 
 from raydp_tpu.cluster import api as cluster
+from raydp_tpu.cluster.common import ClusterError
 from raydp_tpu.etl import plan as lp
 from raydp_tpu.etl.dataframe import DataFrame
 from raydp_tpu.etl.executor import EtlExecutor
@@ -127,14 +129,12 @@ class EtlSession:
             self.configs.get("etl.actor.resource.cpu", executor_cores)
         )
         self.executors = []
-        import time as _time
-
         for i in range(num_executors):
             bundle = -1
             if self._pg is not None:
                 indexes = self._bundle_indexes or list(range(num_executors))
                 bundle = indexes[i % len(indexes)]
-            deadline = _time.monotonic() + 15.0
+            deadline = time.monotonic() + 15.0
             while True:
                 try:
                     handle = cluster.spawn(
@@ -152,12 +152,13 @@ class EtlSession:
                         block=False,
                     )
                     break
-                except Exception:
+                except ClusterError:
                     # a predecessor session's killed actors may still be
-                    # draining their resources/names; wait briefly
-                    if _time.monotonic() > deadline:
+                    # draining their resources/names; wait briefly (other
+                    # errors — bad config, pickling — fail immediately)
+                    if time.monotonic() > deadline:
                         raise
-                    _time.sleep(0.2)
+                    time.sleep(0.2)
             self.executors.append(handle)
         for handle in self.executors:
             handle.wait_ready()

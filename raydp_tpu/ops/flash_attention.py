@@ -124,11 +124,13 @@ def _flash_forward(
         block_k=block_k,
     )
     # under shard_map (manual partitioning — the only way Mosaic kernels run
-    # multi-device) the out_shape must carry the inputs' varying-axes set
+    # multi-device) the out_shape must carry the UNION of the inputs'
+    # varying-axes sets (any operand may be the sharded one)
     out_sds = jax.ShapeDtypeStruct((bh, t, d), q.dtype)
-    vma = getattr(jax.typeof(qf), "vma", None)
-    if vma:
-        out_sds = jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=vma)
+    vmas = [getattr(jax.typeof(a), "vma", None) for a in (qf, kf, vf)]
+    if any(v is not None for v in vmas):
+        union = frozenset().union(*[v for v in vmas if v is not None])
+        out_sds = jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=union)
     out = pl.pallas_call(
         kernel,
         out_shape=out_sds,
