@@ -272,8 +272,6 @@ class EtlSession:
     def kill_executors(self, count: int = 1) -> int:
         """Scale down by killing ``count`` executors (intentional exit: no
         restart). Blocks they produced are GC'd by ownership."""
-        import time
-
         from raydp_tpu.cluster.common import ActorState
 
         victims = self.executors[-count:] if count else []
@@ -319,8 +317,6 @@ class EtlSession:
         self.executors = []
         # drain: wait for the head to reap the executors so their resources
         # and names are free before a subsequent init_etl schedules
-        import time
-
         deadline = time.monotonic() + 15.0
         for handle in killed:
             while time.monotonic() < deadline:

@@ -140,6 +140,23 @@ def test_recoverable_conversion(session):
     assert after_table.sort_by("id").column("x").to_pylist() == before
 
 
+def test_ml_dataset_from_parquet(session, tmp_path):
+    from raydp_tpu.exchange import MLDataset
+
+    pdf = pd.DataFrame(
+        {"a": np.arange(40, dtype=np.float32), "b": np.arange(40, dtype=np.float32) * 2}
+    )
+    df = session.from_pandas(pdf, num_partitions=2)
+    df.write_parquet(str(tmp_path))
+
+    mlds = MLDataset.from_parquet(str(tmp_path), num_shards=2, shuffle=True, shuffle_seed=1)
+    assert mlds.num_shards == 2
+    assert mlds.count() >= 40  # equal-share oversampling may add rows
+    loader = mlds.to_torch(1, ["a"], "b", batch_size=10)
+    xb, yb = next(iter(loader))
+    assert xb.shape[1] == 1 and len(yb) == len(xb)
+
+
 def test_device_put_batch_sharded(session, cpu_mesh_devices):
     import jax
     from jax.sharding import Mesh
