@@ -6,17 +6,15 @@ innermost, so the per-q-block statistics (running max m, denominator l,
 unnormalized output o) persist across k iterations and the full [T, T] score
 matrix never materializes — O(T) memory instead of O(T²). Scores run on the
 MXU (`preferred_element_type=f32`); masking and the softmax update run on the
-VPU.
+VPU. Causal masking uses global positions (runtime offsets from SMEM), and
+k-blocks entirely in the future are skipped outright (~2x causal throughput).
 
-Composes with the sequence-parallel layer: ring attention's per-device block
-product (parallel/ring_attention._block_attn) is exactly one (q-block,
-k-block) tile of this kernel, so ``flash_attention`` is the single-device /
-per-shard compute path and the ring provides the cross-device reduction.
-
-Backward: gradients recompute through the exact jnp reference (attention
-gradients via autodiff of the stable softmax) — the standard
-recompute-in-backward trade; fine for the sequence lengths a single device
-holds.
+One kernel serves two surfaces:
+- ``flash_attention``: normalized output, offsets 0 — the single-device /
+  per-shard attention op (custom VJP recomputes through the exact reference).
+- ``flash_attention_stats``: UNNORMALIZED output + (m, l) stats with caller
+  offsets — the per-ring-step block product `parallel.ring_attention`
+  merges across devices (``use_flash=True``).
 
 Off-TPU the same kernel runs in interpret mode, so CPU-mesh tests exercise
 the identical code path.
@@ -33,8 +31,8 @@ NEG_INF = -1e30
 
 
 def _flash_kernel(
-    q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc, *, scale, causal,
-    block_q, block_k,
+    q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+    o_acc, m_acc, l_acc, *, scale, causal, block_q, block_k, normalize,
 ):
     from jax.experimental import pallas as pl
 
@@ -49,10 +47,14 @@ def _flash_kernel(
         l_acc[:] = jnp.zeros_like(l_acc)
 
     # causal: a k-block entirely in the future contributes nothing — skip its
-    # matmul + update outright (~2x causal throughput)
-    block_live = (
-        qi * block_q + block_q - 1 >= ki * block_k if causal else ki >= 0
-    )
+    # matmul + update outright (~2x causal throughput). Offsets are runtime
+    # values (SMEM), so the predicate is computed at runtime too.
+    if causal:
+        q_last = q_off_ref[0] + qi * block_q + block_q - 1
+        k_first = k_off_ref[0] + ki * block_k
+        block_live = q_last >= k_first
+    else:
+        block_live = ki >= 0
 
     @pl.when(block_live)
     def _accumulate():
@@ -64,10 +66,10 @@ def _flash_kernel(
         ) * scale  # [BQ, BK]
 
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+            q_pos = q_off_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 0
             )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            k_pos = k_off_ref[0] + ki * block_k + jax.lax.broadcasted_iota(
                 jnp.int32, (block_q, block_k), 1
             )
             scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
@@ -91,11 +93,37 @@ def _flash_kernel(
 
     @pl.when(ki == num_k - 1)
     def _finalize():
-        o_ref[0] = (o_acc[:] / jnp.maximum(l_acc[:, :1], 1e-30)).astype(o_ref.dtype)
+        if normalize:
+            o_ref[0] = (
+                o_acc[:] / jnp.maximum(l_acc[:, :1], 1e-30)
+            ).astype(o_ref.dtype)
+        else:
+            o_ref[0] = o_acc[:].astype(o_ref.dtype)
+        m_ref[0] = m_acc[:, :1]
+        l_ref[0] = l_acc[:, :1]
 
 
-def _flash_forward(
-    q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool | None
+def _union_vma(*arrays):
+    vmas = [getattr(jax.typeof(a), "vma", None) for a in arrays]
+    if any(v is not None for v in vmas):
+        return frozenset().union(*[v for v in vmas if v is not None])
+    return None
+
+
+def _pvary_scalar(x, axis_name):
+    from jax import lax
+
+    try:
+        return lax.pcast(x, (axis_name,), to="varying")
+    except (AttributeError, ValueError):
+        try:
+            return lax.pvary(x, (axis_name,))
+        except (AttributeError, ValueError):
+            return x
+
+
+def _flash_call(
+    q, k, v, q_offset, k_offset, causal, block_q, block_k, interpret, normalize
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
@@ -114,41 +142,87 @@ def _flash_forward(
     qf = q.reshape(bh, t, d)
     kf = k.reshape(bh, tk, d)
     vf = v.reshape(bh, tk, d)
-    scale = d**-0.5
 
     kernel = functools.partial(
         _flash_kernel,
-        scale=scale,
-        causal=causal,
-        block_q=block_q,
-        block_k=block_k,
+        scale=d**-0.5, causal=causal, block_q=block_q, block_k=block_k,
+        normalize=normalize,
     )
     # under shard_map (manual partitioning — the only way Mosaic kernels run
-    # multi-device) the out_shape must carry the UNION of the inputs'
-    # varying-axes sets (any operand may be the sharded one)
-    out_sds = jax.ShapeDtypeStruct((bh, t, d), q.dtype)
-    vmas = [getattr(jax.typeof(a), "vma", None) for a in (qf, kf, vf)]
-    if any(v is not None for v in vmas):
-        union = frozenset().union(*[v for v in vmas if v is not None])
-        out_sds = jax.ShapeDtypeStruct((bh, t, d), q.dtype, vma=union)
-    out = pl.pallas_call(
+    # multi-device) out_shape must carry the UNION of the inputs' varying axes
+    union = _union_vma(qf, kf, vf)
+
+    def sds(shape, dtype):
+        if union is not None:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=union)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    q_off = jnp.asarray([q_offset], jnp.int32)
+    k_off = jnp.asarray([k_offset], jnp.int32)
+    if union is not None:  # SMEM scalars must match the kernel vma too
+        for axis in union:
+            q_off = _pvary_scalar(q_off, axis)
+            k_off = _pvary_scalar(k_off, axis)
+
+    out_dtype = q.dtype if normalize else jnp.float32
+    o, m, l = pl.pallas_call(  # noqa: E741
         kernel,
-        out_shape=out_sds,
+        out_shape=(
+            sds((bh, t, d), out_dtype),
+            sds((bh, t, 1), jnp.float32),
+            sds((bh, t, 1), jnp.float32),
+        ),
         grid=(bh, t // block_q, tk // block_k),
         in_specs=[
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
             pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
             pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0)),
         ],
-        out_specs=pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+        out_specs=(
+            pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+            pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0)),
+        ),
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
             pltpu.VMEM((block_q, 128), jnp.float32),
         ],
         interpret=interpret,
-    )(qf, kf, vf)
-    return out.reshape(b, h, t, d)
+    )(q_off, k_off, qf, kf, vf)
+    return (
+        o.reshape(b, h, t, d),
+        m.reshape(b, h, t),
+        l.reshape(b, h, t),
+    )
+
+
+def flash_attention_stats(
+    q, k, v, q_offset, k_offset, causal: bool = False,
+    block_q: int = 128, block_k: int = 128, interpret: bool | None = None,
+):
+    """One blockwise-attention pass returning (o_unnormalized, m, l).
+
+    Shapes: q [B,H,Tq,D], k/v [B,H,Tk,D]; offsets are scalars (traced OK)
+    giving the blocks' global positions for causal masking. Outputs:
+    o [B,H,Tq,D] (unnormalized, f32), m and l [B,H,Tq] — merge across passes
+    with the standard flash merge, divide by l at the end.
+    """
+    return _flash_call(
+        q, k, v, q_offset, k_offset, causal, block_q, block_k, interpret,
+        normalize=False,
+    )
+
+
+def _flash_forward(
+    q, k, v, causal: bool, block_q: int, block_k: int, interpret: bool | None
+):
+    o, _, _ = _flash_call(
+        q, k, v, 0, 0, causal, block_q, block_k, interpret, normalize=True
+    )
+    return o
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

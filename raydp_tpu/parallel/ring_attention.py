@@ -64,39 +64,54 @@ def ring_attention(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = False,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """Exact attention with sequence sharded over ``axis_name``.
 
     Call inside ``shard_map`` (or any SPMD context where ``axis_name`` is
     bound). Shapes are per-device: q, k, v: [B, H, T_local, D]; the global
     sequence is ``T_local * axis_size`` in ring order.
+
+    ``use_flash=True`` computes each (Q-block, K/V-block) product with the
+    fused pallas flash kernel (O(T_local) VMEM, MXU scores) instead of the
+    einsum path; the cross-device merge is identical.
     """
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, t, d = q.shape
+    tk = k.shape[2]
 
     q_pos = jnp.arange(t)
-    k_pos = jnp.arange(k.shape[2])
+    k_pos = jnp.arange(tk)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
-    def block_mask(s):
-        src = (my_idx - s) % n  # which block the current K/V originated from
+    def block_product(k_cur, v_cur, s):
+        """(o_un, m, l) of q against the K/V block that originated on device
+        (my_idx - s) mod n."""
+        src = (my_idx - s) % n
+        if use_flash:
+            from raydp_tpu.ops.flash_attention import flash_attention_stats
+
+            return flash_attention_stats(
+                q, k_cur, v_cur, my_idx * t, src * tk, causal
+            )
         if causal:
-            # global positions: query row qi in block my_idx vs key kj in src
             gq = my_idx * t + q_pos
-            gk = src * k.shape[2] + k_pos
-            return gq[:, None] >= gk[None, :]
-        return jnp.ones((t, k.shape[2]), bool)
+            gk = src * tk + k_pos
+            mask = gq[:, None] >= gk[None, :]
+        else:
+            mask = jnp.ones((t, tk), bool)
+        return _block_attn(q, k_cur, v_cur, mask)
 
     # step 0: the local block, no communication
-    o, m, l = _block_attn(q, k, v, block_mask(0))  # noqa: E741
+    o, m, l = block_product(k, v, 0)  # noqa: E741
 
     def step(carry, s):
         o, m, l, k_cur, v_cur = carry  # noqa: E741
         # permute FIRST, then attend — no dead rotation after the last use
         k_cur = lax.ppermute(k_cur, axis_name, perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm)
-        o2, m2, l2 = _block_attn(q, k_cur, v_cur, block_mask(s))
+        o2, m2, l2 = block_product(k_cur, v_cur, s)
         o, m, l = _merge(o, m, l, o2, m2, l2)  # noqa: E741
         return (o, m, l, k_cur, v_cur), None
 
@@ -104,11 +119,12 @@ def ring_attention(
         (o, m, l, _, _), _ = lax.scan(  # noqa: E741
             step, (o, m, l, k, v), jnp.arange(1, n)
         )
-    return o / jnp.maximum(l[..., None], 1e-30)
+    # keep the caller's dtype (flash block products accumulate in f32)
+    return (o / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
 
 
 def ring_attention_sharded(
-    q, k, v, mesh, axis: str = "sp", causal: bool = False
+    q, k, v, mesh, axis: str = "sp", causal: bool = False, use_flash: bool = False
 ):
     """Convenience wrapper: q/k/v are global arrays sharded over ``axis`` on
     the sequence dim; runs ring_attention under shard_map."""
@@ -121,11 +137,20 @@ def ring_attention_sharded(
 
     spec = P(None, None, axis, None)
 
+    kwargs = {}
+    if use_flash:
+        # the pallas interpreter can't reconcile invariant grid slices with
+        # varying operands; JAX's documented workaround is check_vma=False
+        # (numerics are validated against full attention in tests)
+        kwargs["check_vma"] = False
     fn = shard_map(
-        partial(ring_attention, axis_name=axis, causal=causal),
+        partial(
+            ring_attention, axis_name=axis, causal=causal, use_flash=use_flash
+        ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
+        **kwargs,
     )
     return fn(q, k, v)
 
