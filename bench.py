@@ -112,16 +112,41 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
 
 
 
-def best_of(n_samples: int, fn, best=min):
-    """Run fn() n times, return the best value (min for durations, max for
-    throughputs). The TPU tunnel's throughput is volatile run-to-run, so
-    every timed side of the comparison samples the same way."""
-    return best(fn() for _ in range(n_samples))
+N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 3))
+_warmed = False
 
 
-def timed_fit(est, ds, n_samples: int = 2):
-    """Best-of-n wall time of est.fit(ds) excluding measured compile; returns
-    (best_train_seconds, max_compile_seconds)."""
+def warm_probe():
+    """Run a few hundred tiny jitted steps before any timing so the first
+    measured sample isn't paying tunnel/backend warm-up (the TPU tunnel's
+    first dispatches after idle are erratically slow)."""
+    global _warmed
+    if _warmed:
+        return
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((256, 256))
+    f = jax.jit(lambda a: a @ a)
+    for _ in range(200):
+        x = f(x)
+    jax.block_until_ready(x)
+    _warmed = True
+
+
+def median_of(n_samples: int, fn):
+    """Run fn() n times, return the median (the tunnel's throughput is
+    volatile run-to-run — 5-60s swings for identical work — so both sides of
+    every comparison take the median of the same sample count)."""
+    import statistics
+
+    warm_probe()
+    return statistics.median(fn() for _ in range(n_samples))
+
+
+def timed_fit(est, ds, n_samples: int = N_SAMPLES):
+    """Median-of-n wall time of est.fit(ds) excluding measured compile;
+    returns (median_train_seconds, max_compile_seconds)."""
     compiles = []
 
     def one_fit():
@@ -130,7 +155,7 @@ def timed_fit(est, ds, n_samples: int = 2):
         compiles.append(est.compile_seconds_)
         return time.perf_counter() - t1 - est.compile_seconds_
 
-    return best_of(n_samples, one_fit), max(compiles)
+    return median_of(n_samples, one_fit), max(compiles)
 
 def pure_jax_throughput(model, loss_fn, x, y, batch: int, epochs: int) -> float:
     """Shared pure-JAX baseline: jit step + adam, warm compile, timed epochs.
@@ -184,7 +209,7 @@ def bench_pure_jax(n_rows: int, batch: int, epochs: int):
     def mse(pred, target):
         return jnp.mean((pred.reshape(target.shape) - target) ** 2)
 
-    sps = best_of(2, lambda: pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs), best=max)
+    sps = median_of(N_SAMPLES, lambda: pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs))
     return (n_rows // batch) * batch * epochs, (n_rows // batch) * batch * epochs / sps
 
 
@@ -262,7 +287,7 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
             optax.sigmoid_binary_cross_entropy(pred.reshape(target.shape), target)
         )
 
-    pure_sps = best_of(2, lambda: pure_jax_throughput(model, bce, x, y, batch, epochs), best=max)
+    pure_sps = median_of(N_SAMPLES, lambda: pure_jax_throughput(model, bce, x, y, batch, epochs))
 
     return {
         "etl_s": round(t_etl, 2),

@@ -263,15 +263,63 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
     # ------------------------------------------------------------------
 
     def fit(self, train_ds, evaluate_ds=None, max_retries: int = 0) -> List[Dict[str, float]]:
+        import jax
+
         attempts = 0
-        while True:
-            try:
-                return self._fit_once(train_ds, evaluate_ds)
-            except Exception:
-                attempts += 1
-                if attempts > max_retries:
-                    raise
-                time.sleep(1.0)
+        # Snapshot the pre-existing newest checkpoint so retries only resume
+        # from epochs saved by THIS run — a stale checkpoint from a prior fit
+        # in a reused dir must not short-circuit training. Multi-process runs
+        # are excluded: only process 0 writes, so a node-local dir would make
+        # ranks disagree on the resume epoch and desync the collectives (the
+        # SPMD watchdog coordinates multi-host resume instead).
+        retry_resume = (
+            max_retries > 0
+            and self.checkpoint_dir
+            and jax.process_count() == 1
+        )
+        baseline_epoch = self._latest_checkpoint_epoch() if retry_resume else None
+        saved_resume = self.resume_from_epoch
+        try:
+            while True:
+                try:
+                    return self._fit_once(train_ds, evaluate_ds)
+                except Exception:
+                    attempts += 1
+                    if attempts > max_retries:
+                        raise
+                    if retry_resume:
+                        latest = self._latest_checkpoint_epoch()
+                        if latest is not None and (
+                            baseline_epoch is None or latest > baseline_epoch
+                        ):
+                            # never resume past the end: a crash after the
+                            # final epoch's checkpoint would start at
+                            # num_epochs and return an empty history —
+                            # re-run at least the final epoch instead
+                            resume = min(latest, self.num_epochs - 2)
+                            if resume >= 0:
+                                self.resume_from_epoch = resume
+                    time.sleep(1.0)
+        finally:
+            # retries must not leak resume state into a later fit() call
+            self.resume_from_epoch = saved_resume
+
+    def _latest_checkpoint_epoch(self) -> Optional[int]:
+        """Highest epoch with a committed checkpoint under checkpoint_dir
+        (orbax renames the tmp dir only after a successful commit, so a bare
+        ``epoch_N`` directory is a complete checkpoint)."""
+        import re
+
+        root = os.path.abspath(self.checkpoint_dir)
+        if not os.path.isdir(root):
+            return None
+        epochs = [
+            int(m.group(1))
+            for name in os.listdir(root)
+            for m in [re.fullmatch(r"epoch_(\d+)", name)]
+            if m and os.path.isdir(os.path.join(root, name))
+        ]
+        return max(epochs) if epochs else None
 
     def _fit_once(self, train_ds, evaluate_ds) -> List[Dict[str, float]]:
         import jax

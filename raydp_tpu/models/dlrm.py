@@ -38,6 +38,22 @@ class DLRM(nn.Module):
     @nn.compact
     def __call__(self, x):
         dense = x[:, : self.num_dense].astype(self.dtype)
+        # Categorical ids may arrive through the estimator's single float
+        # feature matrix. Floats represent integers exactly only up to
+        # 2^mantissa — beyond that, distinct ids collapse onto the same
+        # embedding row silently. Trace-time guard (dtype and vocab sizes are
+        # static): require an exact representation or integer inputs.
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            mantissa = jnp.finfo(x.dtype).nmant + 1
+            max_vocab = max(self.vocab_sizes)
+            # integers up to 2^mantissa INCLUSIVE are exact; max id is vocab-1
+            if max_vocab - 1 > 2**mantissa:
+                raise ValueError(
+                    f"vocab size {max_vocab} exceeds exact-integer range of "
+                    f"{x.dtype} features (2^{mantissa}); pass ids as integers "
+                    "(per-column dtypes in Dataset.to_numpy) or use float64 "
+                    "features"
+                )
         ids = x[:, self.num_dense :].astype(jnp.int32)  # [B, S]
 
         # bottom MLP → dense embedding of dim embed_dim

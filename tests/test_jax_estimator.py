@@ -143,6 +143,60 @@ def test_resume_from_checkpoint(session, linear_df):
     assert os.path.isdir(os.path.join(ckpt, "epoch_4"))
 
 
+def test_retry_resumes_from_latest_checkpoint(session, linear_df):
+    """fit(max_retries=N) must not replay finished epochs: after a failure it
+    resumes from the latest committed checkpoint (ADVICE round 1)."""
+    ckpt = tempfile.mkdtemp()
+    ds = dataframe_to_dataset(linear_df)
+    est = JaxEstimator(
+        model=_mlp(), feature_columns=["x", "y"], label_column="z",
+        batch_size=128, num_epochs=5, checkpoint_dir=ckpt, seed=0,
+    )
+
+    real_fit_once = est._fit_once
+    calls = {"n": 0}
+
+    def flaky_fit_once(train_ds, evaluate_ds):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # simulate a crash after epoch 2's checkpoint landed
+            est.num_epochs = 3
+            real_fit_once(train_ds, evaluate_ds)
+            est.num_epochs = 5
+            raise RuntimeError("injected crash after epoch 2")
+        return real_fit_once(train_ds, evaluate_ds)
+
+    est._fit_once = flaky_fit_once
+    history = est.fit(ds, max_retries=1)
+    # resumed at epoch 3 (latest checkpoint = epoch_2), not from scratch
+    assert [r["epoch"] for r in history] == [3, 4]
+    assert est._latest_checkpoint_epoch() == 4
+    # retry state must not leak: a later fit() trains from scratch, and a
+    # pre-existing checkpoint (epoch_4) must not short-circuit its retries
+    assert est.resume_from_epoch is None
+    history2 = est.fit(ds)
+    assert [r["epoch"] for r in history2] == [0, 1, 2, 3, 4]
+
+
+def test_dlrm_rejects_lossy_float_ids():
+    """Float32 features cannot represent ids ≥ 2^24 exactly; DLRM must refuse
+    at trace time instead of silently training on collided embedding rows."""
+    import jax
+    from raydp_tpu.models import DLRM
+
+    model = DLRM(vocab_sizes=[2**24 + 2], num_dense=2, embed_dim=4)
+    x = np.zeros((4, 3), dtype=np.float32)
+    with pytest.raises(ValueError, match="exact-integer range"):
+        jax.eval_shape(lambda a: model.init(jax.random.PRNGKey(0), a), x)
+
+    # float64 carries ids up to 2^53 — accepted (needs x64 enabled, else
+    # JAX silently downcasts the input to float32 and the guard fires)
+    with jax.enable_x64(True):
+        ok = DLRM(vocab_sizes=[2**24 + 2], num_dense=2, embed_dim=4)
+        x64 = np.zeros((4, 3), dtype=np.float64)
+        jax.eval_shape(lambda a: ok.init(jax.random.PRNGKey(0), a), x64)
+
+
 def test_batch_sharded_over_mesh(session, linear_df, cpu_mesh_devices):
     """The train step must actually run sharded: batch size is rounded up to
     a multiple of the mesh and each device sees batch/8 rows."""
