@@ -113,16 +113,14 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
 
 
 N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 3))
-_warmed = False
 
 
 def warm_probe():
-    """Run a few hundred tiny jitted steps before any timing so the first
-    measured sample isn't paying tunnel/backend warm-up (the TPU tunnel's
-    first dispatches after idle are erratically slow)."""
-    global _warmed
-    if _warmed:
-        return
+    """Run a few hundred tiny jitted steps before a timed section so the
+    first measured sample isn't paying tunnel/backend warm-up (the tunnel's
+    first dispatches after idle are erratically slow). Runs before EVERY
+    timed section — minutes of untimed ETL can sit between them and the
+    tunnel goes cold again."""
     import jax
     import jax.numpy as jnp
 
@@ -131,7 +129,6 @@ def warm_probe():
     for _ in range(200):
         x = f(x)
     jax.block_until_ready(x)
-    _warmed = True
 
 
 def median_of(n_samples: int, fn):
@@ -301,6 +298,43 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
     }
 
 
+def validate_flash_compiled():
+    """Exactness check of the COMPILED (non-interpret) flash kernel, forward
+    and backward, vs the einsum reference — only meaningful on the real chip
+    (off-TPU both paths interpret). Returns max abs errors or None off-TPU."""
+    import jax
+    import jax.numpy as jnp
+
+    if jax.default_backend() != "tpu":
+        return None
+    from raydp_tpu.ops import flash_attention
+    from raydp_tpu.ops.flash_attention import _reference
+
+    rng = np.random.default_rng(5)
+    q, k, v = (
+        jnp.asarray(rng.standard_normal((1, 4, 512, 64)), jnp.float32)
+        for _ in range(3)
+    )
+    g = jnp.asarray(rng.standard_normal((1, 4, 512, 64)), jnp.float32)
+    out = flash_attention(q, k, v, True, 128, 128, False)
+    ref = _reference(q, k, v, True)
+    fwd_err = float(jnp.max(jnp.abs(out - ref)))
+    _, vjp = jax.vjp(lambda a, b, c: flash_attention(a, b, c, True, 128, 128, False), q, k, v)
+    _, rvjp = jax.vjp(lambda a, b, c: _reference(a, b, c, True), q, k, v)
+    bwd_err = max(
+        float(jnp.max(jnp.abs(x - y))) for x, y in zip(vjp(g), rvjp(g))
+    )
+    # MXU rounding bound: the reference's own deviation from a highest-
+    # precision run measures ~1.4e-2 on these shapes, so 5e-2 is a real
+    # exactness gate, not a free pass. Report ok:false rather than raising —
+    # a kernel regression must not discard the run's measured numbers.
+    return {
+        "fwd_max_err": round(fwd_err, 6),
+        "bwd_max_err": round(bwd_err, 6),
+        "ok": bool(fwd_err < 5e-2 and bwd_err < 5e-2),
+    }
+
+
 def main():
     _maybe_force_cpu()
     n_rows = int(os.environ.get("BENCH_ROWS", 200_000))
@@ -345,6 +379,7 @@ def main():
             "batch": batch,
             "epochs": epochs,
             "dlrm": dlrm,
+            "flash_compiled": validate_flash_compiled(),
         },
     }
     print(json.dumps(result))

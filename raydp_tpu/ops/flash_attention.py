@@ -11,7 +11,9 @@ k-blocks entirely in the future are skipped outright (~2x causal throughput).
 
 One kernel serves two surfaces:
 - ``flash_attention``: normalized output, offsets 0 — the single-device /
-  per-shard attention op (custom VJP recomputes through the exact reference).
+  per-shard attention op. Its custom VJP is a blockwise FlashAttention-2
+  backward (two pallas kernels over the saved output + logsumexp), so
+  TRAINING is O(T) memory too — no [T, T] matrix in either direction.
 - ``flash_attention_stats``: UNNORMALIZED output + (m, l) stats with caller
   offsets — the per-ring-step block product `parallel.ring_attention`
   merges across devices (``use_flash=True``).
@@ -30,6 +32,31 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
+def _causal_block_live(q_off_ref, k_off_ref, qi, ki, block_q, block_k, causal):
+    """Whether a (q-block, k-block) pair has any unmasked entry. Causal: a
+    k-block entirely in the future contributes nothing — skip its matmul +
+    update outright (~2x causal throughput). Offsets are runtime values
+    (SMEM), so the predicate is computed at runtime too."""
+    if not causal:
+        return ki >= 0
+    q_last = q_off_ref[0] + qi * block_q + block_q - 1
+    k_first = k_off_ref[0] + ki * block_k
+    return q_last >= k_first
+
+
+def _causal_mask(s, q_off_ref, k_off_ref, qi, ki, block_q, block_k):
+    """Mask scores s [BQ, BK] to NEG_INF where global k position > q
+    position. Shared by the forward and both backward kernels so the mask
+    semantics can never diverge between them."""
+    q_pos = q_off_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = k_off_ref[0] + ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 def _flash_kernel(
     q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
     o_acc, m_acc, l_acc, *, scale, causal, block_q, block_k, normalize,
@@ -46,15 +73,9 @@ def _flash_kernel(
         m_acc[:] = jnp.full_like(m_acc, NEG_INF)
         l_acc[:] = jnp.zeros_like(l_acc)
 
-    # causal: a k-block entirely in the future contributes nothing — skip its
-    # matmul + update outright (~2x causal throughput). Offsets are runtime
-    # values (SMEM), so the predicate is computed at runtime too.
-    if causal:
-        q_last = q_off_ref[0] + qi * block_q + block_q - 1
-        k_first = k_off_ref[0] + ki * block_k
-        block_live = q_last >= k_first
-    else:
-        block_live = ki >= 0
+    block_live = _causal_block_live(
+        q_off_ref, k_off_ref, qi, ki, block_q, block_k, causal
+    )
 
     @pl.when(block_live)
     def _accumulate():
@@ -66,13 +87,9 @@ def _flash_kernel(
         ) * scale  # [BQ, BK]
 
         if causal:
-            q_pos = q_off_ref[0] + qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
+            scores = _causal_mask(
+                scores, q_off_ref, k_off_ref, qi, ki, block_q, block_k
             )
-            k_pos = k_off_ref[0] + ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
 
         m_prev = m_acc[:, :1]  # [BQ, 1] (stats broadcast across lanes)
         l_prev = l_acc[:, :1]
@@ -225,6 +242,214 @@ def _flash_forward(
     return o
 
 
+# ---------------------------------------------------------------------------
+# backward kernels (FlashAttention-2): blockwise dq/dk/dv from the saved
+# normalized output and per-row logsumexp — O(T) memory for TRAINING too, not
+# just the forward. Two kernels because TPU has no cross-block atomics:
+# dq iterates k-blocks innermost (accumulating one q-block's dq in VMEM),
+# dk/dv iterates q-blocks innermost (accumulating one k-block's dk+dv).
+# ---------------------------------------------------------------------------
+
+
+def _bwd_dq_kernel(
+    q_off_ref, k_off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, dsum_ref,
+    dq_ref, dq_acc, *, scale, causal, block_q, block_k,
+):
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    block_live = _causal_block_live(
+        q_off_ref, k_off_ref, qi, ki, block_q, block_k, causal
+    )
+
+    @pl.when(block_live)
+    def _accumulate():
+        q = q_ref[0]
+        k = k_ref[0]
+        v = v_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]  # [BQ, 1]
+        dsum = dsum_ref[0]  # [BQ, 1]  rowsum(do * o)
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = _causal_mask(s, q_off_ref, k_off_ref, qi, ki, block_q, block_k)
+        p = jnp.exp(s - lse)  # masked entries: exp(NEG_INF - lse) == 0
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum) * scale
+        dq_acc[:] += jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(
+    q_off_ref, k_off_ref, k_ref, v_ref, q_ref, do_ref, lse_ref, dsum_ref,
+    dk_ref, dv_ref, dk_acc, dv_acc, *, scale, causal, block_q, block_k,
+):
+    from jax.experimental import pallas as pl
+
+    kj = pl.program_id(1)
+    qi = pl.program_id(2)
+    num_q = pl.num_programs(2)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    block_live = _causal_block_live(
+        q_off_ref, k_off_ref, qi, kj, block_q, block_k, causal
+    )
+
+    @pl.when(block_live)
+    def _accumulate():
+        k = k_ref[0]
+        v = v_ref[0]
+        q = q_ref[0]
+        do = do_ref[0].astype(jnp.float32)
+        lse = lse_ref[0]
+        dsum = dsum_ref[0]
+
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale
+        if causal:
+            s = _causal_mask(s, q_off_ref, k_off_ref, qi, kj, block_q, block_k)
+        p = jnp.exp(s - lse)  # [BQ, BK]
+        dv_acc[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        dp = jax.lax.dot_general(
+            do, v, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        ds = p * (dp - dsum) * scale  # [BQ, BK]
+        dk_acc[:] += jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+
+    @pl.when(qi == num_q - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _flash_backward(
+    q, k, v, o, lse, g, causal, block_q, block_k, interpret
+):
+    """Blockwise dq/dk/dv. lse: [B,H,T] logsumexp of the scaled scores;
+    o: normalized forward output; g: cotangent of o."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    b, h, t, d = q.shape
+    tk = k.shape[2]
+    block_q = min(block_q, t)
+    block_k = min(block_k, tk)
+    bh = b * h
+    scale = d**-0.5
+
+    qf = q.reshape(bh, t, d)
+    kf = k.reshape(bh, tk, d)
+    vf = v.reshape(bh, tk, d)
+    dof = g.reshape(bh, t, d)
+    lsef = lse.reshape(bh, t, 1)
+    # dsum_i = rowsum(do_i * o_i): tiny elementwise pass outside the kernels
+    dsumf = jnp.sum(
+        dof.astype(jnp.float32) * o.reshape(bh, t, d).astype(jnp.float32),
+        axis=-1, keepdims=True,
+    )
+
+    union = _union_vma(qf, kf, vf, dof)
+
+    def sds(shape, dtype):
+        if union is not None:
+            return jax.ShapeDtypeStruct(shape, dtype, vma=union)
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    q_off = jnp.asarray([0], jnp.int32)
+    k_off = jnp.asarray([0], jnp.int32)
+    if union is not None:
+        for axis in union:
+            q_off = _pvary_scalar(q_off, axis)
+            k_off = _pvary_scalar(k_off, axis)
+
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, block_q, d), lambda b_, i, j: (b_, i, 0))
+    k_spec_dq = pl.BlockSpec((1, block_k, d), lambda b_, i, j: (b_, j, 0))
+    stat_spec_dq = pl.BlockSpec((1, block_q, 1), lambda b_, i, j: (b_, i, 0))
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _bwd_dq_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        out_shape=sds((bh, t, d), q.dtype),
+        grid=(bh, t // block_q, tk // block_k),
+        in_specs=[
+            smem, smem, q_spec, k_spec_dq, k_spec_dq, q_spec,
+            stat_spec_dq, stat_spec_dq,
+        ],
+        out_specs=q_spec,
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        interpret=interpret,
+    )(q_off, k_off, qf, kf, vf, dof, lsef, dsumf)
+
+    # dk/dv: k-block outer, q-block inner
+    k_spec = pl.BlockSpec((1, block_k, d), lambda b_, j, i: (b_, j, 0))
+    q_spec_kv = pl.BlockSpec((1, block_q, d), lambda b_, j, i: (b_, i, 0))
+    stat_spec_kv = pl.BlockSpec((1, block_q, 1), lambda b_, j, i: (b_, i, 0))
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _bwd_dkv_kernel,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+        ),
+        out_shape=(sds((bh, tk, d), k.dtype), sds((bh, tk, d), v.dtype)),
+        grid=(bh, tk // block_k, t // block_q),
+        in_specs=[
+            smem, smem, k_spec, k_spec, q_spec_kv, q_spec_kv,
+            stat_spec_kv, stat_spec_kv,
+        ],
+        out_specs=(k_spec, k_spec),
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q_off, k_off, kf, vf, qf, dof, lsef, dsumf)
+
+    return (
+        dq.reshape(b, h, t, d),
+        dk.reshape(b, h, tk, d),
+        dv.reshape(b, h, tk, d),
+    )
+
+
+def _reference(q, k, v, causal):
+    # single source of truth for exact attention (the gradcheck oracle; must
+    # stay in lockstep with the parallel layer)
+    from raydp_tpu.parallel.ring_attention import full_attention
+
+    return full_attention(q, k, v, causal=causal)
+
+
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
 def flash_attention(
     q, k, v, causal: bool = False, block_q: int = 128, block_k: int = 128,
@@ -234,23 +459,20 @@ def flash_attention(
     return _flash_forward(q, k, v, causal, block_q, block_k, interpret)
 
 
-def _reference(q, k, v, causal):
-    # single source of truth for exact attention (gradients recompute
-    # through this, so it must stay in lockstep with the parallel layer)
-    from raydp_tpu.parallel.ring_attention import full_attention
-
-    return full_attention(q, k, v, causal=causal)
-
-
 def _fwd(q, k, v, causal, block_q, block_k, interpret):
-    out = _flash_forward(q, k, v, causal, block_q, block_k, interpret)
-    return out, (q, k, v)
+    o, m, l = _flash_call(  # noqa: E741
+        q, k, v, 0, 0, causal, block_q, block_k, interpret, normalize=True
+    )
+    # residuals are O(T): inputs + normalized output + per-row logsumexp
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))
+    return o, (q, k, v, o, lse)
 
 
 def _bwd(causal, block_q, block_k, interpret, residuals, g):
-    q, k, v = residuals
-    _, vjp = jax.vjp(lambda q_, k_, v_: _reference(q_, k_, v_, causal), q, k, v)
-    return vjp(g)
+    q, k, v, o, lse = residuals
+    return _flash_backward(
+        q, k, v, o, lse, g, causal, block_q, block_k, interpret
+    )
 
 
 flash_attention.defvjp(_fwd, _bwd)
