@@ -395,6 +395,139 @@ def test_moe_expert_parallel_matches_dense(cpu_mesh_devices):
     np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad), atol=1e-4)
 
 
+def test_moe_top2_matches_dense(cpu_mesh_devices):
+    """Top-2 routing with renormalized gates must equal the dense two-expert
+    mixture when capacity is ample, and expose aux stats."""
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu.parallel import make_mesh, moe_sharded
+
+    N, D, B = 4, 8, 64
+    mesh = make_mesh({"ep": N}, jax.devices()[:N])
+    rng = np.random.default_rng(21)
+    Ws = jnp.asarray(rng.standard_normal((N, D, D)) * 0.5, jnp.float32)
+    Wr = jnp.asarray(rng.standard_normal((D, N)) * 0.5, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((B, D)), jnp.float32)
+
+    def expert_fn(W, t):
+        return jax.nn.relu(t @ W)
+
+    gates = jax.nn.softmax(x @ Wr, -1)
+    top_vals, top_idx = jax.lax.top_k(gates, 2)
+    w = top_vals / jnp.sum(top_vals, -1, keepdims=True)
+    dense = jnp.stack([expert_fn(Ws[e], x) for e in range(N)], 1)  # [B,N,D]
+    ref = (
+        dense[jnp.arange(B), top_idx[:, 0]] * w[:, :1]
+        + dense[jnp.arange(B), top_idx[:, 1]] * w[:, 1:]
+    )
+
+    out, aux = moe_sharded(
+        expert_fn, Ws, Wr, x, mesh, capacity_factor=8.0, top_k=2,
+        return_aux=True,
+    )
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
+    assert float(aux["drop_fraction"]) == 0.0  # ample capacity
+    assert float(aux["load_balance_loss"]) >= 1.0  # ==1 only at perfect balance
+
+    # gradients flow through the top-2 combine
+    grad = jax.grad(
+        lambda ws: jnp.sum(
+            moe_sharded(expert_fn, ws, Wr, x, mesh, capacity_factor=8.0, top_k=2) ** 2
+        )
+    )(Ws)
+
+    def dense_loss(ws):
+        d = jnp.stack([expert_fn(ws[e], x) for e in range(N)], 1)
+        o = (
+            d[jnp.arange(B), top_idx[:, 0]] * w[:, :1]
+            + d[jnp.arange(B), top_idx[:, 1]] * w[:, 1:]
+        )
+        return jnp.sum(o ** 2)
+
+    ref_grad = jax.grad(dense_loss)(Ws)
+    np.testing.assert_allclose(np.asarray(grad), np.asarray(ref_grad), atol=1e-4)
+
+
+def test_moe_drop_fraction_visible(cpu_mesh_devices):
+    """Tokens beyond capacity are dropped — round 1 did this silently; the
+    drop fraction must now be reported."""
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu.parallel import make_mesh, moe_sharded
+
+    N, D, B = 4, 8, 64
+    mesh = make_mesh({"ep": N}, jax.devices()[:N])
+    rng = np.random.default_rng(22)
+    Ws = jnp.asarray(rng.standard_normal((N, D, D)), jnp.float32)
+    # router biased hard toward expert 0 → guaranteed overflow at cf=1.0
+    Wr = jnp.asarray(
+        np.concatenate(
+            [np.full((D, 1), 3.0), np.zeros((D, N - 1))], axis=1
+        ),
+        jnp.float32,
+    )
+    x = jnp.abs(jnp.asarray(rng.standard_normal((B, D)), jnp.float32))
+
+    _, aux = moe_sharded(
+        lambda W, t: t @ W, Ws, Wr, x, mesh, capacity_factor=1.0, top_k=1,
+        return_aux=True,
+    )
+    assert float(aux["drop_fraction"]) > 0.2
+    assert float(aux["load_balance_loss"]) > 1.5  # collapsed router
+
+
+def test_moe_aux_loss_reduces_imbalance(cpu_mesh_devices):
+    """Training the router against load_balance_loss must spread the load:
+    the loss falls toward 1.0 (perfect balance) and drops disappear."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from raydp_tpu.parallel import make_mesh, moe_sharded
+
+    N, D, B = 4, 8, 64
+    mesh = make_mesh({"ep": N}, jax.devices()[:N])
+    rng = np.random.default_rng(23)
+    Ws = jnp.asarray(rng.standard_normal((N, D, D)) * 0.5, jnp.float32)
+    # collapsed start: every token prefers expert 0
+    Wr0 = jnp.asarray(
+        np.concatenate([np.full((D, 1), 2.0), np.zeros((D, N - 1))], 1)
+        + rng.standard_normal((D, N)) * 0.01,
+        jnp.float32,
+    )
+    x = jnp.abs(jnp.asarray(rng.standard_normal((B, D)), jnp.float32))
+
+    def aux_of(wr):
+        _, aux = moe_sharded(
+            lambda W, t: t @ W, Ws, wr, x, mesh, capacity_factor=1.25,
+            top_k=2, return_aux=True,
+        )
+        return aux["load_balance_loss"], aux["drop_fraction"]
+
+    tx = optax.adam(0.05)
+    opt_state = tx.init(Wr0)
+
+    @jax.jit
+    def step(wr, opt_state):
+        lb, _ = aux_of(wr)
+        g = jax.grad(lambda w: aux_of(w)[0])(wr)
+        updates, opt_state = tx.update(g, opt_state, wr)
+        return optax.apply_updates(wr, updates), opt_state, lb
+
+    wr = Wr0
+    lb_first = None
+    for _ in range(120):
+        wr, opt_state, lb = step(wr, opt_state)
+        if lb_first is None:
+            lb_first = float(lb)
+    lb_last, drop_last = (float(v) for v in aux_of(wr))
+    assert lb_first > 1.5, f"start not collapsed: {lb_first}"
+    assert lb_last < 1.15, f"aux loss failed to rebalance: {lb_last}"
+    assert drop_last < 0.05, f"drops persist after rebalancing: {drop_last}"
+
+
 def test_flash_attention_composes_with_shard_map(cpu_mesh_devices):
     """Mosaic kernels can't be AUTO-partitioned, but under shard_map (manual
     partitioning) the flash kernel runs per shard — the composition ring
