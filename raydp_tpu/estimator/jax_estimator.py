@@ -154,6 +154,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         donate_state: bool = True,
         profile_dir: Optional[str] = None,
         resume_from_epoch: Optional[int] = None,
+        streaming: bool = False,
     ):
         self._model_arg = model
         self._optimizer_arg = optimizer
@@ -174,6 +175,10 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         self.donate_state = donate_state
         self.profile_dir = profile_dir
         self.resume_from_epoch = resume_from_epoch
+        # streaming=True: epochs iterate the dataset block-by-block with
+        # double-buffered staging — host memory O(block) instead of
+        # O(dataset); shuffle becomes block-order + within-block
+        self.streaming = streaming
 
         self._module = None
         self._params = None
@@ -336,16 +341,38 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         tx = self._resolve_optimizer()
         loss_fn = self._resolve_loss()
 
-        # Arrow → host numpy exactly once; every epoch only reshuffles indices
-        train_host = self._stage_host(train_ds)
-        eval_host = self._stage_host(evaluate_ds) if evaluate_ds is not None else None
+        if self.streaming:
+            # O(block) memory: no up-front staging; each epoch streams blocks
+            # with double buffering (multi-process shards are block-span
+            # plans — nothing is materialized here). The init sample comes
+            # straight from the first non-empty block: shapes are all that
+            # matter, and this avoids spinning up a producer thread.
+            from raydp_tpu.exchange.dataset import _table_to_numpy
+
+            if train_ds.count() == 0:
+                raise ValueError("streaming fit on an empty dataset")
+            train_source = train_ds
+            eval_source = evaluate_ds
+            first = next(i for i, c in enumerate(train_ds.counts) if c > 0)
+            feats, _ = _table_to_numpy(
+                train_ds.get_block(first), self.feature_columns,
+                self.label_column, self.feature_dtype, self.label_dtype,
+            )
+            sample_np = np.resize(feats, (batch_size, feats.shape[1]))
+        else:
+            # Arrow → host numpy exactly once; epochs only reshuffle indices
+            train_source = self._stage_host(train_ds)
+            eval_source = (
+                self._stage_host(evaluate_ds) if evaluate_ds is not None else None
+            )
+            sample_np = train_source.features[:batch_size]
 
         enable_persistent_compilation_cache()
         compile_start = time.perf_counter()
         rng = jax.random.PRNGKey(self.seed)
         # one jitted init: flax init run eagerly compiles dozens of tiny ops,
         # which costs ~0.5s EACH on cold TPU backends (measured ~30s total)
-        sample = jnp.asarray(train_host.features[:batch_size])
+        sample = jnp.asarray(sample_np)
         params, opt_state = jax.jit(
             lambda r, s: (lambda p: (p, tx.init(p)))(module.init(r, s))
         )(rng, sample)
@@ -428,7 +455,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 epoch_start = time.perf_counter()
                 epoch_seed = None if not self.shuffle else self.seed + epoch
                 train_iter = PrefetchingDeviceIterator(
-                    train_host.iter(batch_size, self.shuffle, epoch_seed), mesh
+                    self._epoch_batches(train_source, batch_size, epoch_seed),
+                    mesh,
                 )
                 loss_sum = jnp.zeros((), jnp.float32)
                 steps = 0
@@ -456,9 +484,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     "train_loss": (loss_sum, steps),
                     "epoch_seconds": time.perf_counter() - epoch_start,
                 }
-                if eval_host is not None:
+                if eval_source is not None:
                     record.update(
-                        self._evaluate_host(eval_host, params, eval_step, mesh, batch_size)
+                        self._evaluate_host(eval_source, params, eval_step, mesh, batch_size)
                     )
                 self._history.append(record)
                 # multi-process: only process 0 writes (concurrent orbax
@@ -472,6 +500,30 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         self._module = module
         self._params = jax.device_get(params)
         return self._history
+
+    def _epoch_batches(self, source, batch_size, seed, shuffle=None):
+        """One epoch of host batches from either a staged ``_HostArrays`` or
+        a ``Dataset`` (streamed block-by-block, O(block) memory). Multi-
+        process streaming shards by block-span plan — equal rows per process
+        (the divide_blocks invariant) with nothing materialized."""
+        import jax
+
+        if shuffle is None:
+            shuffle = self.shuffle
+        if isinstance(source, _HostArrays):
+            return source.iter(batch_size, shuffle, seed)
+        from raydp_tpu.exchange.dataset import streaming_shard_plan
+
+        plan = None
+        p = jax.process_count()
+        if p > 1:
+            plan = streaming_shard_plan(source.counts, p, jax.process_index())
+        return source.iter_batches(
+            batch_size, self.feature_columns, self.label_column,
+            shuffle=shuffle, seed=seed, drop_last=True,
+            feature_dtype=self.feature_dtype, label_dtype=self.label_dtype,
+            streaming=True, block_plan=plan,
+        )
 
     def _make_eval_step(self, module, loss_fn):
         import jax
@@ -487,7 +539,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         return eval_step
 
     def _evaluate_host(
-        self, host: "_HostArrays", params, eval_step, mesh, batch_size
+        self, source, params, eval_step, mesh, batch_size
     ) -> Dict[str, float]:
         import jax.numpy as jnp
 
@@ -497,7 +549,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         loss_sum = jnp.zeros(())
         count = jnp.zeros(())
         for x, y in PrefetchingDeviceIterator(
-            host.iter(batch_size, shuffle=False, seed=None), mesh
+            self._epoch_batches(source, batch_size, None, shuffle=False), mesh
         ):
             mstate, loss_sum, count = eval_step(params, mstate, loss_sum, count, x, y)
         out = {"eval_loss": float(loss_sum) / max(float(count), 1.0)}
@@ -510,9 +562,10 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             raise RuntimeError("call fit() first")
         mesh = self._resolve_mesh()
         eval_step = self._make_eval_step(self._module, self._resolve_loss())
+        source = ds if self.streaming else self._stage_host(ds)
         with mesh:
             return self._evaluate_host(
-                self._stage_host(ds),
+                source,
                 self._params,
                 eval_step,
                 mesh,

@@ -228,21 +228,10 @@ class Dataset:
         label_dtype=np.float32,
     ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
         """Materialize as a dense feature matrix [N, F] (+ label vector)."""
-        table = self.to_arrow()
-        cols = [
-            table.column(c).combine_chunks().to_numpy(zero_copy_only=False)
-            for c in feature_columns
-        ]
-        features = np.stack(cols, axis=1).astype(feature_dtype)
-        labels = None
-        if label_column is not None:
-            labels = (
-                table.column(label_column)
-                .combine_chunks()
-                .to_numpy(zero_copy_only=False)
-                .astype(label_dtype)
-            )
-        return features, labels
+        return _table_to_numpy(
+            self.to_arrow(), feature_columns, label_column,
+            feature_dtype, label_dtype,
+        )
 
     def iter_batches(
         self,
@@ -254,7 +243,36 @@ class Dataset:
         drop_last: bool = False,
         feature_dtype=np.float32,
         label_dtype=np.float32,
+        streaming: bool = False,
+        block_plan: Optional[List[Tuple[int, int, int]]] = None,
     ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
+        """Batches of (features [B, F], labels [B]).
+
+        ``streaming=False`` (default): stage the whole dataset once, shuffle
+        globally — fastest when it fits in host memory.
+        ``streaming=True``: O(block) host memory — blocks are staged one at
+        a time with one block prefetched in a background thread (double
+        buffering); shuffling is block-order + within-block (the standard
+        streaming trade vs a global shuffle). Batches straddle block
+        boundaries via a carryover, so batch shapes are identical to the
+        staged path. ``block_plan`` (streaming only) restricts the pass to
+        ``streaming_shard_plan`` spans without materializing slices.
+        """
+        if streaming:
+            return StreamingBatchIterator(
+                self, batch_size, feature_columns, label_column,
+                shuffle, seed, drop_last, feature_dtype, label_dtype,
+                block_plan=block_plan,
+            )
+        return self._iter_batches_staged(
+            batch_size, feature_columns, label_column, shuffle, seed,
+            drop_last, feature_dtype, label_dtype,
+        )
+
+    def _iter_batches_staged(
+        self, batch_size, feature_columns, label_column, shuffle, seed,
+        drop_last, feature_dtype, label_dtype,
+    ):
         features, labels = self.to_numpy(
             feature_columns, label_column, feature_dtype, label_dtype
         )
@@ -310,6 +328,193 @@ class Dataset:
 
     def owners(self) -> List[Optional[str]]:
         return [store.owner_of(b) for b in self.blocks]
+
+
+def _table_to_numpy(
+    table: pa.Table,
+    feature_columns: Sequence[str],
+    label_column: Optional[str],
+    feature_dtype,
+    label_dtype,
+) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    cols = [
+        table.column(c).combine_chunks().to_numpy(zero_copy_only=False)
+        for c in feature_columns
+    ]
+    features = np.stack(cols, axis=1).astype(feature_dtype)
+    labels = None
+    if label_column is not None:
+        labels = (
+            table.column(label_column)
+            .combine_chunks()
+            .to_numpy(zero_copy_only=False)
+            .astype(label_dtype)
+        )
+    return features, labels
+
+
+def streaming_shard_plan(
+    counts: Sequence[int], num_shards: int, rank: int
+) -> List[Tuple[int, int, int]]:
+    """Block-level plan for one rank's equal-rows shard: a list of
+    ``(block_index, start_row, stop_row)`` spans covering the contiguous
+    global row interval ``[rank·per, (rank+1)·per)`` with wraparound
+    oversampling (``per = ceil(total/num_shards)``) — the divide_blocks
+    equal-count invariant WITHOUT materializing any slice, so streaming
+    consumers stay O(block) in memory."""
+    counts = list(counts)
+    total = sum(counts)
+    if total == 0:
+        return []
+    per = -(-total // num_shards)
+    bounds = np.cumsum([0] + counts)
+    spans: List[Tuple[int, int, int]] = []
+    pos = (rank * per) % total
+    remaining = per
+    while remaining > 0:
+        b = int(np.searchsorted(bounds, pos, side="right") - 1)
+        off = pos - int(bounds[b])
+        take = min(counts[b] - off, remaining)
+        spans.append((b, off, off + take))
+        remaining -= take
+        pos = (pos + take) % total
+    return spans
+
+
+class StreamingBatchIterator:
+    """Block-streaming batch iterator: host memory is O(largest block), not
+    O(dataset). A background thread stages the NEXT block (Arrow → numpy)
+    while batches are served from the current one; a carryover joins rows
+    across block boundaries so every batch is full-size.
+
+    ``peak_staged_rows`` records the high-water mark of rows resident at
+    once (current + carryover + the one prefetched block) — tests assert it
+    stays far below the dataset size.
+
+    Iterable AND iterator: ``iter(it)`` starts a fresh pass; ``next(it)``
+    lazily starts (and continues) a single pass.
+
+    ``block_plan`` optionally restricts the pass to ``(block, start, stop)``
+    spans (see ``streaming_shard_plan``) — the multi-process shard path.
+    """
+
+    def __init__(
+        self, ds: "Dataset", batch_size: int,
+        feature_columns: Sequence[str], label_column: Optional[str],
+        shuffle: bool, seed: Optional[int], drop_last: bool,
+        feature_dtype, label_dtype,
+        block_plan: Optional[List[Tuple[int, int, int]]] = None,
+    ):
+        self._ds = ds
+        self._batch_size = batch_size
+        self._feature_columns = list(feature_columns)
+        self._label_column = label_column
+        self._shuffle = shuffle
+        self._seed = seed
+        self._drop_last = drop_last
+        self._feature_dtype = feature_dtype
+        self._label_dtype = label_dtype
+        self._block_plan = block_plan
+        self._active_gen = None
+        self.peak_staged_rows = 0
+
+    def _total_rows(self) -> int:
+        if self._block_plan is not None:
+            return sum(stop - start for _, start, stop in self._block_plan)
+        return self._ds.count()
+
+    def __len__(self) -> int:
+        total = self._total_rows()
+        if self._drop_last:
+            return total // self._batch_size
+        return -(-total // self._batch_size)
+
+    def __next__(self):
+        if self._active_gen is None:
+            self._active_gen = self.__iter__()
+        return next(self._active_gen)
+
+    def __iter__(self):
+        import queue
+        import threading
+
+        ds = self._ds
+        rng = np.random.default_rng(self._seed)
+        if self._block_plan is not None:
+            plan = list(self._block_plan)
+        else:
+            plan = [(i, 0, c) for i, c in enumerate(ds.counts)]
+        order = np.arange(len(plan))
+        if self._shuffle:
+            rng.shuffle(order)
+
+        # maxsize=1 → exactly one block staged ahead (double buffering)
+        staged: "queue.Queue" = queue.Queue(maxsize=1)
+        stop = threading.Event()
+
+        def producer():
+            try:
+                for oi in order:
+                    if stop.is_set():
+                        return
+                    bi, row_start, row_stop = plan[int(oi)]
+                    table = ds.get_block(int(bi))
+                    if row_start != 0 or row_stop != table.num_rows:
+                        table = table.slice(row_start, row_stop - row_start)
+                    if table.num_rows == 0:
+                        continue
+                    pair = _table_to_numpy(
+                        table, self._feature_columns,
+                        self._label_column, self._feature_dtype,
+                        self._label_dtype,
+                    )
+                    staged.put(pair)
+                staged.put(None)
+            except BaseException as e:  # surface in the consumer
+                staged.put(e)
+
+        thread = threading.Thread(target=producer, daemon=True)
+        thread.start()
+        try:
+            batch = self._batch_size
+            left_f = left_l = None
+            while True:
+                item = staged.get()
+                if item is None:
+                    break
+                if isinstance(item, BaseException):
+                    raise item
+                feats, labels = item
+                if self._shuffle:
+                    perm = rng.permutation(len(feats))
+                    feats = feats[perm]
+                    labels = labels[perm] if labels is not None else None
+                if left_f is not None and len(left_f):
+                    feats = np.concatenate([left_f, feats])
+                    if labels is not None:
+                        labels = np.concatenate([left_l, labels])
+                resident = len(feats)
+                if staged.qsize():  # safe peek: only this thread consumes
+                    head = staged.queue[0]
+                    if head is not None and not isinstance(head, BaseException):
+                        resident += len(head[0])
+                self.peak_staged_rows = max(self.peak_staged_rows, resident)
+                full = (len(feats) // batch) * batch
+                for s in range(0, full, batch):
+                    yield feats[s : s + batch], (
+                        labels[s : s + batch] if labels is not None else None
+                    )
+                left_f = feats[full:]
+                left_l = labels[full:] if labels is not None else None
+            if left_f is not None and len(left_f) and not self._drop_last:
+                yield left_f, left_l
+        finally:
+            stop.set()
+            # unblock a producer waiting on a full queue
+            try:
+                staged.get_nowait()
+            except Exception:
+                pass
 
 
 # ---------------------------------------------------------------------------

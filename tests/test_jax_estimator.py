@@ -218,6 +218,31 @@ def test_batch_sharded_over_mesh(session, linear_df, cpu_mesh_devices):
     assert len(history) == 1
 
 
+def test_streaming_fit(session, linear_df):
+    """streaming=True trains block-by-block in O(block) host memory and still
+    converges; eval runs through the same streamed path."""
+    train_df, eval_df = linear_df.random_split([0.8, 0.2], seed=4)
+    est = JaxEstimator(
+        model=_mlp(),
+        optimizer="adam",
+        loss="mse",
+        feature_columns=["x", "y"],
+        label_column="z",
+        batch_size=64,
+        num_epochs=6,
+        learning_rate=3e-3,
+        seed=0,
+        streaming=True,
+    )
+    history = est.fit_on_etl(train_df, eval_df)
+    assert len(history) == 6
+    assert history[-1]["train_loss"] < history[0]["train_loss"] * 0.3
+    assert "eval_loss" in history[-1]
+    model = est.get_model()
+    pred = np.asarray(model(np.array([[0.5, 0.5]], dtype=np.float32)))
+    assert abs(pred[0, 0] - 8.5) < 1.5
+
+
 def test_stop_etl_after_conversion(session):
     """fit_on_etl(stop_etl_after_conversion=True) frees the ETL engine before
     training; data survives via ownership transfer (reference :352-361)."""
