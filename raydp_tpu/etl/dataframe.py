@@ -79,6 +79,54 @@ class DataFrame:
         return DataFrame(self._session, lp.Project(self._plan, named))
 
     def with_column(self, name: str, expr: ColumnLike) -> "DataFrame":
+        from raydp_tpu.etl.expressions import WindowExpr
+
+        if isinstance(expr, WindowExpr):
+            if not expr.bound:
+                raise ValueError(
+                    "window function must be bound with .over(...) before use"
+                )
+            child = self._plan
+            if name in self.columns:
+                # withColumn replaces: the window compute appends (which
+                # would duplicate the name), and the expr may READ the old
+                # column — compute into a temp name, then project-rename
+                tmp = f"__window__{name}"
+                win = lp.Window(
+                    child, list(expr.partition_by), list(expr.order_by),
+                    list(expr.ascending), [(tmp, expr)],
+                )
+                named = [
+                    (c, ColumnRef(c)) for c in self.columns if c != name
+                ] + [(name, ColumnRef(tmp))]
+                return DataFrame(self._session, lp.Project(win, named))
+            if (
+                isinstance(child, lp.Window)
+                and child.partition_by == list(expr.partition_by)
+                and child.order_by == list(expr.order_by)
+                and child.ascending == list(expr.ascending)
+                and name not in {n for n, _ in child.exprs}
+                and (
+                    expr.column is None
+                    or expr.column not in {n for n, _ in child.exprs}
+                )
+            ):
+                # same window spec back-to-back: batch into ONE shuffle+sort
+                return DataFrame(
+                    self._session,
+                    lp.Window(
+                        child.child, child.partition_by, child.order_by,
+                        child.ascending, list(child.exprs) + [(name, expr)],
+                        child.num_partitions,
+                    ),
+                )
+            return DataFrame(
+                self._session,
+                lp.Window(
+                    child, list(expr.partition_by), list(expr.order_by),
+                    list(expr.ascending), [(name, expr)],
+                ),
+            )
         named = [(c, ColumnRef(c)) for c in self.columns if c != name]
         named.append((name, _c(expr)))
         return DataFrame(self._session, lp.Project(self._plan, named))
@@ -176,7 +224,22 @@ class DataFrame:
     def agg(self, *aggs: AggExpr) -> "DataFrame":
         return GroupedData(self, []).agg(*aggs)
 
-    def join(self, other: "DataFrame", on: Union[str, Sequence[str]], how: str = "inner") -> "DataFrame":
+    def join(
+        self,
+        other: "DataFrame",
+        on: Union[str, Sequence[str]],
+        how: str = "inner",
+        broadcast: Optional[str] = None,
+    ) -> "DataFrame":
+        """``broadcast="right"`` forces a broadcast join (no shuffle of
+        either side; the right side ships whole to every left partition);
+        ``broadcast="none"`` forces the hash-shuffle path; the default
+        (None) lets the planner auto-broadcast a small materialized right
+        side (Spark autoBroadcastJoinThreshold parity)."""
+        if broadcast not in (None, "right", "none"):
+            raise ValueError(
+                f"broadcast must be None, 'right', or 'none', got {broadcast!r}"
+            )
         keys = [on] if isinstance(on, str) else list(on)
         how = {
             "inner": "inner",
@@ -192,7 +255,10 @@ class DataFrame:
             "anti": "left anti",
             "left_anti": "left anti",
         }.get(how, how)
-        return DataFrame(self._session, lp.Join(self._plan, other._plan, keys, how))
+        return DataFrame(
+            self._session,
+            lp.Join(self._plan, other._plan, keys, how, broadcast=broadcast),
+        )
 
     def sort(self, *cols, ascending: Union[bool, Sequence[bool]] = True) -> "DataFrame":
         keys = [c if isinstance(c, str) else c.name_hint() for c in cols]

@@ -429,3 +429,29 @@ class AggExpr:
 
     def alias(self, name: str) -> "AggExpr":
         return AggExpr(self.agg, self.column, name)
+
+
+@dataclass(eq=False)
+class WindowExpr:
+    """A window function bound to its (partition_by, order_by) spec via
+    ``.over(...)`` in the F namespace. Executed by ``tasks.window_compute``
+    after a hash shuffle on the partition keys (Spark window semantics;
+    the reference gets these free from Spark SQL)."""
+
+    kind: str  # row_number | rank | dense_rank | lag | lead | cum_sum
+    column: Optional[str] = None  # input column (lag/lead/cum_sum)
+    offset: int = 1  # lag/lead distance
+    default: Any = None  # lag/lead fill for out-of-partition rows (None=null)
+    partition_by: List[str] = None  # type: ignore[assignment]
+    order_by: List[str] = None  # type: ignore[assignment]
+    ascending: List[bool] = None  # type: ignore[assignment]
+
+    def __post_init__(self):
+        if self.kind not in (
+            "row_number", "rank", "dense_rank", "lag", "lead", "cum_sum"
+        ):
+            raise ValueError(f"unsupported window function {self.kind!r}")
+
+    @property
+    def bound(self) -> bool:
+        return self.partition_by is not None

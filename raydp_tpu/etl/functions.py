@@ -219,3 +219,106 @@ def hash(c: ColumnLike, num_buckets: Optional[int] = None) -> Expr:  # noqa: A00
 def udf(func: Callable, *cols: ColumnLike, dtype=None) -> Expr:
     """Vectorized UDF over whole-column arrays (numpy in, array out)."""
     return Udf(func, [_c(c) for c in cols], dtype)
+
+
+# -- window functions ---------------------------------------------------------
+
+
+class WindowSpec:
+    """pyspark-style window spec: ``Window.partitionBy("k").orderBy("ts")``."""
+
+    def __init__(self, partition_by=(), order_by=(), ascending=()):
+        self._partition_by = list(partition_by)
+        self._order_by = list(order_by)
+        self._ascending = list(ascending)
+
+    def partition_by(self, *cols: ColumnLike) -> "WindowSpec":
+        return WindowSpec(
+            [_colname(c) for c in cols], self._order_by, self._ascending
+        )
+
+    partitionBy = partition_by
+
+    def order_by(self, *cols: ColumnLike, ascending=True) -> "WindowSpec":
+        names = [_colname(c) for c in cols]
+        asc = [ascending] * len(names) if isinstance(ascending, bool) else list(ascending)
+        return WindowSpec(self._partition_by, names, asc)
+
+    orderBy = order_by
+
+
+class Window:
+    """Entry points matching ``pyspark.sql.Window``."""
+
+    @staticmethod
+    def partition_by(*cols: ColumnLike) -> WindowSpec:
+        return WindowSpec().partition_by(*cols)
+
+    partitionBy = partition_by
+
+    @staticmethod
+    def order_by(*cols: ColumnLike, ascending=True) -> WindowSpec:
+        return WindowSpec().order_by(*cols, ascending=ascending)
+
+    orderBy = order_by
+
+
+class _WindowFunction:
+    """A window function awaiting ``.over(spec)``."""
+
+    def __init__(self, kind: str, column: Optional[str] = None,
+                 offset: int = 1, default: Any = None):
+        self._kind = kind
+        self._column = column
+        self._offset = offset
+        self._default = default
+
+    def over(self, spec: Optional[WindowSpec] = None, *,
+             partition_by=(), order_by=(), ascending=True):
+        from raydp_tpu.etl.expressions import WindowExpr
+
+        if spec is None:
+            names = [_colname(c) for c in order_by]
+            asc = (
+                [ascending] * len(names)
+                if isinstance(ascending, bool)
+                else list(ascending)
+            )
+            spec = WindowSpec([_colname(c) for c in partition_by], names, asc)
+        if self._kind in ("row_number", "rank", "dense_rank", "lag", "lead") and not spec._order_by:
+            raise ValueError(f"{self._kind} requires an order_by in its window spec")
+        return WindowExpr(
+            self._kind, self._column, self._offset, self._default,
+            partition_by=spec._partition_by, order_by=spec._order_by,
+            ascending=spec._ascending,
+        )
+
+
+def row_number() -> _WindowFunction:
+    return _WindowFunction("row_number")
+
+
+def rank() -> _WindowFunction:
+    return _WindowFunction("rank")
+
+
+def dense_rank() -> _WindowFunction:
+    return _WindowFunction("dense_rank")
+
+
+def lag(c: ColumnLike, offset: int = 1, default: Any = None) -> _WindowFunction:
+    if offset < 0:  # Spark semantics: lag(-n) == lead(n)
+        return lead(c, -offset, default)
+    return _WindowFunction("lag", _colname(c), offset, default)
+
+
+def lead(c: ColumnLike, offset: int = 1, default: Any = None) -> _WindowFunction:
+    if offset < 0:  # Spark semantics: lead(-n) == lag(n)
+        return lag(c, -offset, default)
+    return _WindowFunction("lead", _colname(c), offset, default)
+
+
+def cum_sum(c: ColumnLike) -> _WindowFunction:
+    """Running sum within the partition in order_by order (Spark
+    ``sum(c).over(window.orderBy(...))`` default-frame semantics)."""
+    return _WindowFunction("cum_sum", _colname(c))

@@ -178,6 +178,10 @@ class Join(PlanNode):
     on: List[str]
     how: str = "inner"  # inner | left outer | right outer | full outer | left semi | left anti
     num_partitions: Optional[int] = None
+    # "right" forces broadcasting the right side to every left partition (no
+    # shuffle of either side); None lets the planner auto-broadcast when the
+    # right side is materialized and under the size threshold
+    broadcast: Optional[str] = None
 
     def children(self):
         return [self.left, self.right]
@@ -200,6 +204,24 @@ class Sort(PlanNode):
 @dataclass
 class Distinct(PlanNode):
     child: PlanNode
+    num_partitions: Optional[int] = None
+
+    def children(self):
+        return [self.child]
+
+
+@dataclass
+class Window(PlanNode):
+    """Window functions over (partition_by, order_by): hash-shuffle rows so
+    each partition-key group lands whole on one reducer, sort within, and
+    append the window columns (Spark window semantics; no frame clause —
+    row_number/rank/lag/lead/cumulative)."""
+
+    child: PlanNode
+    partition_by: List[str]
+    order_by: List[str]
+    ascending: List[bool]
+    exprs: List[Tuple[str, Any]]  # (output name, expressions.WindowExpr)
     num_partitions: Optional[int] = None
 
     def children(self):

@@ -206,6 +206,11 @@ class Planner:
             return left.join(right, keys=node.on, join_type=node.how, use_threads=False)
         if isinstance(node, (lp.Sort, lp.Distinct)):
             return self._empty_result(node.children()[0])
+        if isinstance(node, lp.Window):
+            return T.window_compute(
+                self._empty_result(node.child), node.partition_by,
+                node.order_by, node.ascending, node.exprs,
+            )
         raise TypeError(f"cannot infer schema for {type(node).__name__}")
 
     def partition_count(self, node: lp.PlanNode) -> int:
@@ -224,6 +229,10 @@ class Planner:
         if isinstance(node, lp.GroupByAgg):
             return 1 if not node.keys else self._num_partitions(node.num_partitions)
         if isinstance(node, (lp.Join, lp.Sort, lp.Distinct)):
+            return self._num_partitions(node.num_partitions)
+        if isinstance(node, lp.Window):
+            if not node.partition_by:
+                return 1
             return self._num_partitions(node.num_partitions)
         if isinstance(node, lp.Repartition):
             return self._num_partitions(node.num_partitions)
@@ -364,6 +373,8 @@ class Planner:
             return self._execute_sort(offset, base, shipped, output)
         if isinstance(base, lp.Distinct):
             return self._execute_distinct(offset, base, shipped, output)
+        if isinstance(base, lp.Window):
+            return self._execute_window(offset, base, shipped, output)
         raise TypeError(f"cannot execute {type(base).__name__}")
 
     def _reroot(self, narrow: lp.PlanNode, child: lp.PlanNode) -> lp.PlanNode:
@@ -509,9 +520,130 @@ class Planner:
         self._cleanup_intermediate(map_results)
         return out
 
+    # joins whose semantics survive broadcasting only the RIGHT side: each
+    # left partition independently emits its complete result (right/full
+    # outer would duplicate unmatched right rows per partition)
+    _BROADCASTABLE_HOW = ("inner", "left outer", "left semi", "left anti")
+    BROADCAST_THRESHOLD_BYTES = 10 << 20
+
+    def _broadcast_side(self, base: lp.Join) -> Optional[str]:
+        if base.how not in self._BROADCASTABLE_HOW:
+            return None
+        if base.broadcast == "right":
+            return "right"
+        if base.broadcast is not None:
+            return None
+        # auto: broadcast only when the right side is already materialized
+        # (possibly under shrink-only narrow ops) with known total size under
+        # the threshold — the Spark autoBroadcastJoinThreshold analog
+        node = base.right
+        while isinstance(node, (lp.Filter, lp.Sample, lp.PartitionHead, lp.GlobalLimit)):
+            node = node.children()[0]
+        if isinstance(node, lp.ArrowSource) and node.blocks:
+            total = 0
+            for b in node.blocks:
+                size = getattr(b, "size", None)
+                if size is None:
+                    return None
+                total += size
+            if total <= self.BROADCAST_THRESHOLD_BYTES:
+                return "right"
+        return None
+
+    def _execute_broadcast_join(
+        self, offset: int, base: lp.Join, chain: List[lp.PlanNode], output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        """Ship the (small) right side whole to every left partition: the big
+        side is never hash-partitioned — one stage to materialize the right,
+        one join stage over the left's natural partitioning."""
+        right_schema = self.infer_schema(base.right)
+        right_mat = self.materialize(base.right)
+        right_read = T.ReadSpec(
+            "block",
+            blocks=[b for b in right_mat.blocks if b is not None],
+            schema_ipc=T.schema_ipc_bytes(right_schema),
+        )
+        left_mat, left_fresh = self.materialize_node_cached(base.left)
+        left_ipc = T.schema_ipc_bytes(left_mat.schema)
+        specs = [
+            T.TaskSpec(
+                reads=[
+                    T.ReadSpec(
+                        "block",
+                        blocks=[b] if b is not None else [],
+                        schema_ipc=left_ipc,
+                    )
+                ],
+                merge=T.MergeSpec(
+                    "join", keys=list(base.on), right=right_read,
+                    join_how=base.how,
+                ),
+                chain=chain,
+                output=output,
+                partition_index=offset + i,
+            )
+            for i, b in enumerate(left_mat.blocks)
+        ]
+        out = self.submit(specs)
+        self._delete_blocks([b for b in right_mat.blocks if b is not None])
+        if left_fresh:
+            self._delete_blocks([b for b in left_mat.blocks if b is not None])
+        return out
+
+    def _execute_window(
+        self, offset: int, base: lp.Window, chain: List[lp.PlanNode], output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        """Hash-shuffle on partition_by so every group is whole on one
+        reducer, then sort + append window columns there. No partition_by →
+        one global reducer (the Spark warning case)."""
+        child_schema = self.infer_schema(base.child)
+        apply_node = lp.MapBatches(
+            None,  # type: ignore[arg-type]
+            T.WindowApply(
+                base.partition_by, base.order_by, base.ascending, base.exprs
+            ),
+        )
+        if base.partition_by:
+            n = self._num_partitions(base.num_partitions)
+            map_results = self._execute(
+                base.child,
+                T.OutputSpec(
+                    "hash_split", num_splits=n, keys=list(base.partition_by)
+                ),
+            )
+            reads = self._shuffle_reads(map_results, n, child_schema)
+        else:
+            map_results = self._execute(base.child, T.OutputSpec("block"))
+            blocks = [
+                res.blocks[0]
+                for res in map_results
+                if res.blocks and res.blocks[0] is not None
+            ]
+            reads = [
+                T.ReadSpec(
+                    "block", blocks=blocks,
+                    schema_ipc=T.schema_ipc_bytes(child_schema),
+                )
+            ]
+        specs = [
+            T.TaskSpec(
+                reads=[r],
+                merge=T.MergeSpec("none"),
+                chain=[apply_node] + chain,
+                output=output,
+                partition_index=offset + i,
+            )
+            for i, r in enumerate(reads)
+        ]
+        out = self.submit(specs)
+        self._cleanup_intermediate(map_results)
+        return out
+
     def _execute_join(
         self, offset: int, base: lp.Join, chain: List[lp.PlanNode], output: T.OutputSpec
     ) -> List[T.TaskResult]:
+        if self._broadcast_side(base) == "right":
+            return self._execute_broadcast_join(offset, base, chain, output)
         n = self._num_partitions(base.num_partitions)
         left_schema = self.infer_schema(base.left)
         right_schema = self.infer_schema(base.right)

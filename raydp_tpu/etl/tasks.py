@@ -246,6 +246,116 @@ def apply_narrow(table: pa.Table, node: lp.PlanNode, partition_index: int) -> pa
 
 
 # ---------------------------------------------------------------------------
+# Window functions
+# ---------------------------------------------------------------------------
+
+
+def window_compute(
+    table: pa.Table,
+    partition_by: Sequence[str],
+    order_by: Sequence[str],
+    ascending: Sequence[bool],
+    exprs: Sequence[Tuple[str, Any]],
+) -> pa.Table:
+    """Append window columns to one reducer's rows. Every partition-key group
+    is whole here (the planner hash-shuffles on partition_by first), so the
+    computation is local: sort by (partition, order) keys, find group/run
+    boundaries vectorized, and emit each window function from them. Output
+    rows are ordered by (partition_by, order_by) — Spark makes the same
+    within-partition ordering guarantee and no global one."""
+    n = table.num_rows
+    sort_spec = [(k, "ascending") for k in partition_by] + [
+        (k, "ascending" if asc else "descending")
+        for k, asc in zip(order_by, ascending)
+    ]
+    if n and sort_spec:
+        table = table.sort_by(sort_spec)
+
+    def np_col(name):
+        return table.column(name).to_numpy(zero_copy_only=False)
+
+    part_change = np.zeros(n, bool)
+    run_change = np.zeros(n, bool)
+    if n:
+        part_change[0] = run_change[0] = True
+        for k in partition_by:
+            a = np_col(k)
+            part_change[1:] |= a[1:] != a[:-1]
+        run_change |= part_change
+        for k in order_by:
+            a = np_col(k)
+            run_change[1:] |= a[1:] != a[:-1]
+    gstart_idx = np.flatnonzero(part_change)  # [num_groups]
+    gid = np.cumsum(part_change) - 1  # group id per row
+    group_start = gstart_idx[gid] if n else np.zeros(0, np.int64)
+    glen = np.diff(np.append(gstart_idx, n))
+    group_end = (gstart_idx + glen)[gid] if n else np.zeros(0, np.int64)
+    rstart_idx = np.flatnonzero(run_change)  # tie runs (rank/dense_rank)
+    rid = np.cumsum(run_change) - 1
+    run_first = rstart_idx[rid] if n else np.zeros(0, np.int64)
+    rid_at_gstart = rid[group_start] if n else np.zeros(0, np.int64)
+    idx = np.arange(n)
+
+    out = table
+    for name, e in exprs:
+        if e.kind == "row_number":
+            vals = pa.array((idx - group_start + 1).astype(np.int64))
+        elif e.kind == "rank":
+            vals = pa.array((run_first - group_start + 1).astype(np.int64))
+        elif e.kind == "dense_rank":
+            vals = pa.array((rid - rid_at_gstart + 1).astype(np.int64))
+        elif e.kind in ("lag", "lead"):
+            colv = table.column(e.column).combine_chunks()
+            if e.kind == "lag":
+                src = idx - e.offset
+                valid = src >= group_start
+            else:
+                src = idx + e.offset
+                valid = src < group_end
+            taken = colv.take(
+                pa.array(np.clip(src, 0, max(n - 1, 0)).astype(np.int64))
+            )
+            fill = pa.scalar(e.default, colv.type)
+            vals = pc.if_else(pa.array(valid), taken, fill)
+        elif e.kind == "cum_sum":
+            # Spark sum().over() ignores nulls (a null row gets the running
+            # sum of prior non-nulls; rows before the first non-null get
+            # null) — a naive cumsum would NaN-poison every later row AND
+            # every later group on the same reducer via the base subtraction
+            colv = table.column(e.column).combine_chunks()
+            null_mask = np.asarray(colv.is_null())
+            a = np_col(e.column)
+            filled = np.where(null_mask, 0, a)
+            cs = np.cumsum(filled)
+            valid = np.cumsum(~null_mask)
+            if n:
+                run = cs - (cs[group_start] - filled[group_start])
+                seen = valid - (valid[group_start] - (~null_mask)[group_start])
+                vals = pa.array(run, mask=seen == 0)
+            else:
+                vals = pa.array(cs)
+        else:
+            raise TypeError(f"unsupported window function {e.kind!r}")
+        out = out.append_column(name, vals)
+    return out
+
+
+class WindowApply:
+    """Picklable reduce-side closure applying one Window node's functions."""
+
+    def __init__(self, partition_by, order_by, ascending, exprs):
+        self.partition_by = list(partition_by)
+        self.order_by = list(order_by)
+        self.ascending = list(ascending)
+        self.exprs = list(exprs)
+
+    def __call__(self, table: pa.Table) -> pa.Table:
+        return window_compute(
+            table, self.partition_by, self.order_by, self.ascending, self.exprs
+        )
+
+
+# ---------------------------------------------------------------------------
 # Aggregation (two-phase)
 # ---------------------------------------------------------------------------
 

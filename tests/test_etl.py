@@ -333,3 +333,229 @@ def test_schema_inference_matches_execution(session):
     actual = df.to_arrow().schema
     assert inferred.names == actual.names
     assert [f.type for f in inferred] == [f.type for f in actual]
+
+
+# ---------------------------------------------------------------------------
+# window functions (Spark semantics; the reference gets these from Spark SQL)
+# ---------------------------------------------------------------------------
+
+
+def _window_frame(session, n=200, parts=4, seed=5):
+    rng = np.random.default_rng(seed)
+    pdf = pd.DataFrame(
+        {
+            "k": rng.integers(0, 7, n),
+            "ts": rng.permutation(n),
+            "v": rng.standard_normal(n).round(3),
+        }
+    )
+    return pdf, session.from_pandas(pdf, num_partitions=parts)
+
+
+def test_window_row_number_and_rank(session):
+    pdf, df = _window_frame(session)
+    w = F.Window.partition_by("k").order_by("ts")
+    out = (
+        df.with_column("rn", F.row_number().over(w))
+        .with_column("rk", F.rank().over(w))
+        .with_column("drk", F.dense_rank().over(w))
+        .to_pandas()
+        .sort_values(["k", "ts"])
+        .reset_index(drop=True)
+    )
+    exp = pdf.sort_values(["k", "ts"]).reset_index(drop=True)
+    exp["rn"] = exp.groupby("k").cumcount() + 1
+    exp["rk"] = exp.groupby("k")["ts"].rank(method="min").astype(np.int64)
+    exp["drk"] = exp.groupby("k")["ts"].rank(method="dense").astype(np.int64)
+    for c in ("rn", "rk", "drk"):
+        np.testing.assert_array_equal(out[c].to_numpy(), exp[c].to_numpy(), err_msg=c)
+
+
+def test_window_lag_lead_cumsum(session):
+    pdf, df = _window_frame(session, seed=6)
+    w = F.Window.partition_by("k").order_by("ts")
+    out = (
+        df.with_column("prev", F.lag("v", 1).over(w))
+        .with_column("nxt", F.lead("v", 2).over(w))
+        .with_column("prev0", F.lag("v", 1, default=0.0).over(w))
+        .with_column("running", F.cum_sum("v").over(w))
+        .to_pandas()
+        .sort_values(["k", "ts"])
+        .reset_index(drop=True)
+    )
+    exp = pdf.sort_values(["k", "ts"]).reset_index(drop=True)
+    g = exp.groupby("k")["v"]
+    exp["prev"] = g.shift(1)
+    exp["nxt"] = g.shift(-2)
+    exp["prev0"] = g.shift(1).fillna(0.0)
+    exp["running"] = g.cumsum()
+    for c in ("prev", "nxt", "prev0"):
+        np.testing.assert_allclose(
+            out[c].to_numpy(np.float64), exp[c].to_numpy(np.float64),
+            atol=1e-9, err_msg=c,
+        )
+    np.testing.assert_allclose(
+        out["running"].to_numpy(), exp["running"].to_numpy(), atol=1e-6
+    )
+
+
+def test_window_descending_and_global(session):
+    pdf, df = _window_frame(session, n=60, seed=7)
+    # descending order
+    w = F.Window.partition_by("k").order_by("ts", ascending=False)
+    out = (
+        df.with_column("rn", F.row_number().over(w))
+        .to_pandas().sort_values(["k", "ts"]).reset_index(drop=True)
+    )
+    exp = pdf.sort_values(["k", "ts"]).reset_index(drop=True)
+    exp["rn"] = exp.groupby("k")["ts"].rank(method="first", ascending=False).astype(np.int64)
+    np.testing.assert_array_equal(out["rn"].to_numpy(), exp["rn"].to_numpy())
+
+    # no partition_by: one global ordered partition
+    out2 = (
+        df.with_column("rn", F.row_number().over(F.Window.order_by("ts")))
+        .to_pandas().sort_values("ts").reset_index(drop=True)
+    )
+    np.testing.assert_array_equal(out2["rn"].to_numpy(), np.arange(1, 61))
+
+
+def test_window_requires_order_by(session):
+    with pytest.raises(ValueError, match="order_by"):
+        F.row_number().over(F.Window.partition_by("k"))
+
+
+# ---------------------------------------------------------------------------
+# broadcast join
+# ---------------------------------------------------------------------------
+
+
+def test_broadcast_join_matches_hash_join(session):
+    rng = np.random.default_rng(8)
+    big = session.from_pandas(
+        pd.DataFrame({"id": rng.integers(0, 50, 5000), "x": rng.standard_normal(5000)}),
+        num_partitions=6,
+    )
+    small_pdf = pd.DataFrame({"id": np.arange(40), "name": [f"n{i}" for i in range(40)]})
+    small = session.from_pandas(small_pdf, num_partitions=1)
+
+    hash_out = (
+        big.join(small, on="id", how="inner", broadcast="none")
+        .to_pandas().sort_values(["id", "x"]).reset_index(drop=True)
+    )
+    bcast_out = (
+        big.join(small, on="id", how="inner", broadcast="right")
+        .to_pandas().sort_values(["id", "x"]).reset_index(drop=True)
+    )
+    pd.testing.assert_frame_equal(hash_out, bcast_out)
+
+    # left outer keeps unmatched big-side rows exactly once
+    left_out = (
+        big.join(small, on="id", how="left", broadcast="right")
+        .to_pandas().sort_values(["id", "x"]).reset_index(drop=True)
+    )
+    exp = (
+        big.to_pandas().merge(small_pdf, on="id", how="left")
+        .sort_values(["id", "x"]).reset_index(drop=True)
+    )
+    assert len(left_out) == len(exp) == 5000
+
+
+def test_broadcast_join_skips_big_side_shuffle(session):
+    """Stage-count proof that the big side is never hash-partitioned: the
+    broadcast plan runs 2 stages (materialize small + join) vs the hash
+    join's 3 (two map-side splits + reduce)."""
+    rng = np.random.default_rng(9)
+    big = session.from_pandas(
+        pd.DataFrame({"id": rng.integers(0, 20, 2000), "x": rng.standard_normal(2000)}),
+        num_partitions=4,
+    )
+    small = session.from_pandas(
+        pd.DataFrame({"id": np.arange(20), "w": np.arange(20) * 0.5}),
+        num_partitions=1,
+    )
+    planner = session._planner
+
+    big.join(small, on="id", broadcast="right").count()
+    bcast_stages = len(planner.last_query_stats["stages"])
+
+    big.join(small, on="id", broadcast="none").count()
+    hash_stages = len(planner.last_query_stats["stages"])
+    assert bcast_stages < hash_stages, (bcast_stages, hash_stages)
+
+
+def test_broadcast_join_auto_threshold(session):
+    """A small cached (materialized) right side auto-broadcasts without a
+    hint; a right/full outer join never does (wrong semantics)."""
+    rng = np.random.default_rng(10)
+    big = session.from_pandas(
+        pd.DataFrame({"id": rng.integers(0, 30, 3000), "x": rng.standard_normal(3000)}),
+        num_partitions=4,
+    )
+    small = session.from_pandas(
+        pd.DataFrame({"id": np.arange(30), "w": np.arange(30) * 1.0}),
+        num_partitions=1,
+    ).cache()  # ArrowSource with known size → auto-broadcast eligible
+
+    planner = session._planner
+    big.join(small, on="id").count()
+    auto_stages = len(planner.last_query_stats["stages"])
+
+    big.join(small, on="id", broadcast="none").count()
+    hash_stages = len(planner.last_query_stats["stages"])
+    assert auto_stages < hash_stages
+
+    # right outer must take the hash path even when hinted
+    out = big.join(small, on="id", how="right", broadcast="right").to_pandas()
+    exp = big.to_pandas().merge(
+        pd.DataFrame({"id": np.arange(30), "w": np.arange(30) * 1.0}),
+        on="id", how="right",
+    )
+    assert len(out) == len(exp)
+
+
+def test_window_edge_semantics(session):
+    """Replacement, null-skipping cum_sum, negative lag offsets, and
+    same-spec batching into one shuffle."""
+    pdf = pd.DataFrame(
+        {
+            "k": [0, 0, 0, 0, 1, 1],
+            "ts": [0, 1, 2, 3, 0, 1],
+            "v": [1.0, None, 2.0, 3.0, None, 5.0],
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=2)
+    w = F.Window.partition_by("k").order_by("ts")
+
+    # cum_sum skips nulls (Spark sum().over()); leading all-null prefix is null
+    out = (
+        df.with_column("running", F.cum_sum("v").over(w))
+        .to_pandas().sort_values(["k", "ts"]).reset_index(drop=True)
+    )
+    assert out["running"].tolist()[:4] == [1.0, 1.0, 3.0, 6.0]
+    assert pd.isna(out["running"][4]) and out["running"][5] == 5.0
+
+    # with_column REPLACES an existing column (Spark withColumn semantics)
+    replaced = df.with_column("v", F.cum_sum("v").over(w)).to_pandas()
+    assert list(replaced.columns).count("v") == 1
+
+    # lag(-n) == lead(n)
+    neg = (
+        df.with_column("a", F.lag("v", -1).over(w))
+        .with_column("b", F.lead("v", 1).over(w))
+        .to_pandas().sort_values(["k", "ts"]).reset_index(drop=True)
+    )
+    pd.testing.assert_series_equal(neg["a"], neg["b"], check_names=False)
+
+    # back-to-back same-spec window columns collapse into ONE shuffle
+    planner = session._planner
+    df.with_column("rn", F.row_number().over(w)).with_column(
+        "rk", F.rank().over(w)
+    ).count()
+    batched = len(planner.last_query_stats["stages"])
+    df.with_column("rn", F.row_number().over(w)).count()
+    single = len(planner.last_query_stats["stages"])
+    assert batched == single  # no extra shuffle for the second column
+
+    # invalid broadcast value rejected at the API
+    with pytest.raises(ValueError, match="broadcast"):
+        df.join(df, on="k", broadcast="rigth")
