@@ -285,7 +285,9 @@ class _WindowFunction:
                 else list(ascending)
             )
             spec = WindowSpec([_colname(c) for c in partition_by], names, asc)
-        if self._kind in ("row_number", "rank", "dense_rank", "lag", "lead") and not spec._order_by:
+        if not spec._order_by:
+            # every supported function is order-sensitive (cum_sum included:
+            # a running sum over undefined shuffle order is nondeterministic)
             raise ValueError(f"{self._kind} requires an order_by in its window spec")
         return WindowExpr(
             self._kind, self._column, self._offset, self._default,

@@ -557,7 +557,9 @@ class Planner:
         side is never hash-partitioned — one stage to materialize the right,
         one join stage over the left's natural partitioning."""
         right_schema = self.infer_schema(base.right)
-        right_mat = self.materialize(base.right)
+        # cached (ArrowSource) right sides — the auto-broadcast trigger —
+        # are borrowed as-is; only unmaterialized plans cost a stage here
+        right_mat, right_fresh = self.materialize_node_cached(base.right)
         right_read = T.ReadSpec(
             "block",
             blocks=[b for b in right_mat.blocks if b is not None],
@@ -585,7 +587,8 @@ class Planner:
             for i, b in enumerate(left_mat.blocks)
         ]
         out = self.submit(specs)
-        self._delete_blocks([b for b in right_mat.blocks if b is not None])
+        if right_fresh:
+            self._delete_blocks([b for b in right_mat.blocks if b is not None])
         if left_fresh:
             self._delete_blocks([b for b in left_mat.blocks if b is not None])
         return out

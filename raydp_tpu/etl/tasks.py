@@ -274,16 +274,27 @@ def window_compute(
     def np_col(name):
         return table.column(name).to_numpy(zero_copy_only=False)
 
+    def key_codes(name):
+        """Equality-preserving int codes for a key column. Dictionary
+        encoding makes nulls (→ -1) and NaNs compare equal to themselves —
+        a raw numpy != would make every null row its own group (NaN != NaN)."""
+        enc = table.column(name).combine_chunks().dictionary_encode()
+        return (
+            enc.indices.fill_null(-1)
+            .to_numpy(zero_copy_only=False)
+            .astype(np.int64)
+        )
+
     part_change = np.zeros(n, bool)
     run_change = np.zeros(n, bool)
     if n:
         part_change[0] = run_change[0] = True
         for k in partition_by:
-            a = np_col(k)
+            a = key_codes(k)
             part_change[1:] |= a[1:] != a[:-1]
         run_change |= part_change
         for k in order_by:
-            a = np_col(k)
+            a = key_codes(k)
             run_change[1:] |= a[1:] != a[:-1]
     gstart_idx = np.flatnonzero(part_change)  # [num_groups]
     gid = np.cumsum(part_change) - 1  # group id per row
@@ -324,8 +335,11 @@ def window_compute(
             # every later group on the same reducer via the base subtraction
             colv = table.column(e.column).combine_chunks()
             null_mask = np.asarray(colv.is_null())
-            a = np_col(e.column)
-            filled = np.where(null_mask, 0, a)
+            # float64 ALWAYS: a nullable int column becomes float64 on
+            # reducers that hold a null but int64 on ones that don't,
+            # which would give output partitions divergent schemas
+            a = np_col(e.column).astype(np.float64)
+            filled = np.where(null_mask, 0.0, a)
             cs = np.cumsum(filled)
             valid = np.cumsum(~null_mask)
             if n:

@@ -559,3 +559,32 @@ def test_window_edge_semantics(session):
     # invalid broadcast value rejected at the API
     with pytest.raises(ValueError, match="broadcast"):
         df.join(df, on="k", broadcast="rigth")
+
+
+def test_window_null_keys_and_int_cumsum(session):
+    """Null partition keys form ONE group (NaN != NaN must not split them),
+    and cum_sum over a nullable int column has a stable float64 schema
+    regardless of which reducers saw nulls."""
+    pdf = pd.DataFrame(
+        {
+            "k": pd.array([1, None, None, None, 2, 1], dtype="Int64"),
+            "ts": [0, 0, 1, 2, 0, 1],
+            "v": pd.array([1, None, 2, 3, 4, 5], dtype="Int64"),
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=2)
+    w = F.Window.partition_by("k").order_by("ts")
+    out = (
+        df.with_column("rn", F.row_number().over(w))
+        .with_column("cs", F.cum_sum("v").over(w))
+        .to_pandas()
+    )
+    nulls = out[out["k"].isna()].sort_values("ts")
+    assert nulls["rn"].tolist() == [1, 2, 3]  # one group, not three
+    assert nulls["cs"].tolist()[1:] == [2.0, 5.0]
+    assert pd.isna(nulls["cs"].iloc[0])  # leading null value → null sum
+    assert out["cs"].dtype == np.float64
+
+    # cum_sum without an order_by is rejected (undefined running order)
+    with pytest.raises(ValueError, match="order_by"):
+        F.cum_sum("v").over(F.Window.partition_by("k"))

@@ -8,6 +8,8 @@ import sys
 
 import pytest
 
+pytestmark = pytest.mark.slow  # excluded from the fast default suite
+
 ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 

@@ -4,6 +4,9 @@ import sys
 import os
 
 import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # the driver exercises entry()/dryrun_multichip directly
 
 
 def test_entry_jits(cpu_mesh_devices):

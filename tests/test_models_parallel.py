@@ -257,6 +257,7 @@ def test_dlrm_forward_and_sharded_tables(cpu_mesh_devices):
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5)
 
 
+@pytest.mark.slow
 def test_transformer_ring_matches_full(mesh8):
     import jax
     import jax.numpy as jnp

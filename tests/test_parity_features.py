@@ -17,6 +17,7 @@ from raydp_tpu.cluster import api as cluster
 from raydp_tpu.etl import functions as F
 
 
+@pytest.mark.slow
 def test_submit_overrides(tmp_path):
     """raydp-tpu-submit config must win over app args (spark-submit parity)."""
     script = tmp_path / "app.py"
@@ -49,6 +50,7 @@ def test_submit_overrides(tmp_path):
     assert "SUBMIT-OK" in out.stdout, out.stdout + out.stderr
 
 
+@pytest.mark.slow
 def test_dynamic_allocation():
     session = raydp_tpu.init_etl(
         "dyn-alloc", num_executors=1, executor_cores=1, executor_memory="200M"
@@ -135,6 +137,7 @@ def test_query_stats():
         raydp_tpu.stop_etl()
 
 
+@pytest.mark.slow
 def test_concurrent_queries_one_session():
     """Multiple threads driving the same session concurrently (the reference's
     thread-safety-by-construction claim, SURVEY §5)."""

@@ -45,6 +45,7 @@ def test_env_and_numpy_work_in_ranks():
         job.stop()
 
 
+@pytest.mark.slow
 def test_restart_resets_function_ordering():
     job = create_spmd_job("spmd-restart", world_size=2).start()
     try:
@@ -114,6 +115,7 @@ def test_jax_distributed_bootstrap():
         job.stop()
 
 
+@pytest.mark.slow
 def test_multiprocess_jax_estimator_fit():
     """The full multi-host training path: 2 processes × 2 CPU devices form a
     jax.distributed mesh; each process stages only its dataset shard; the

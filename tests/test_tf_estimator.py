@@ -7,6 +7,8 @@ import pytest
 
 import raydp_tpu
 
+pytestmark = pytest.mark.slow  # excluded from the fast default suite
+
 tf = pytest.importorskip("tensorflow")
 
 

@@ -8,6 +8,8 @@ import pytest
 import raydp_tpu
 from raydp_tpu.estimator import TorchEstimator
 
+pytestmark = pytest.mark.slow  # excluded from the fast default suite
+
 
 @pytest.fixture(scope="module")
 def session():
