@@ -95,8 +95,11 @@ def init(
         # the head (and the actors it spawns) must be able to import raydp_tpu
         # and user modules no matter where the driver was launched from
         head_env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        # -S: skip site/sitecustomize (this image's sitecustomize imports jax
+        # + the TPU plugin — ~2.6s the head never needs); imports resolve via
+        # the PYTHONPATH above
         _head_proc = subprocess.Popen(
-            [sys.executable, "-m", "raydp_tpu.cluster.head_main", _session_dir],
+            [sys.executable, "-S", "-m", "raydp_tpu.cluster.head_main", _session_dir],
             start_new_session=True,
             env=head_env,
         )
@@ -337,9 +340,17 @@ def spawn(
     bundle_index: int = -1,
     env: Optional[Dict[str, str]] = None,
     block: bool = True,
+    light: bool = False,
     **kwargs,
 ) -> ActorHandle:
-    """Create an actor process running ``cls(*args, **kwargs)``."""
+    """Create an actor process running ``cls(*args, **kwargs)``.
+
+    ``light=True`` starts the process with ``python -S`` — no
+    site/sitecustomize, which skips environments' expensive startup hooks
+    (this image preimports jax + the TPU plugin there, ~2.6s/process).
+    The framework's own ETL/storage actors opt in; the PUBLIC default stays
+    False because a light actor that later imports jax will silently miss
+    any PJRT plugin a sitecustomize would have registered."""
     res = dict(resources or {})
     if num_cpus:
         res["CPU"] = float(num_cpus)
@@ -359,6 +370,7 @@ def spawn(
         placement_group=placement_group,
         bundle_index=bundle_index,
         env=env,
+        light=light,
     )
     head_rpc("create_actor", spec=spec)
     handle = ActorHandle(session_dir(), spec.actor_id, name)

@@ -116,6 +116,12 @@ class ActorSpec:
     placement_group: Optional[str] = None
     bundle_index: int = -1
     env: Dict[str, str] = dataclasses.field(default_factory=dict)
+    # light=True starts the actor with `python -S`: site/sitecustomize are
+    # skipped (this image's sitecustomize imports jax + the TPU plugin,
+    # ~2.6s per process) and imports resolve via the PYTHONPATH the spawner
+    # provides. ETL/storage actors never touch jax; SPMD ranks that need the
+    # TPU plugin registered must set light=False.
+    light: bool = True
 
 
 @dataclasses.dataclass

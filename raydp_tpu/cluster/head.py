@@ -347,8 +347,9 @@ class Head:
         env["RAYDP_TPU_NODE_IP"] = node.node_ip
         with open(log_base + ".out", "ab") as out, open(log_base + ".err", "ab") as err:
             actor.proc = subprocess.Popen(
-                [
-                    sys.executable,
+                [sys.executable]
+                + (["-S"] if getattr(spec, "light", True) else [])
+                + [
                     "-m",
                     "raydp_tpu.cluster.worker",
                     self.session_dir,

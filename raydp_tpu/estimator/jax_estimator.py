@@ -155,6 +155,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         profile_dir: Optional[str] = None,
         resume_from_epoch: Optional[int] = None,
         streaming: bool = False,
+        sync_every_steps: int = 32,
     ):
         self._model_arg = model
         self._optimizer_arg = optimizer
@@ -179,6 +180,12 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         # double-buffered staging — host memory O(block) instead of
         # O(dataset); shuffle becomes block-order + within-block
         self.streaming = streaming
+        # cap the async dispatch queue: drain every N steps. Unbounded
+        # queues of distinct-input steps permanently degrade dispatch ~25x
+        # on tunneled PJRT transports (measured: >~100 undrained steps);
+        # on local hardware the periodic drain costs one pipeline bubble
+        # per N steps (<1%). 0 disables.
+        self.sync_every_steps = sync_every_steps
 
         self._module = None
         self._params = None
@@ -239,12 +246,30 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
     def _stage_host(self, ds) -> "_HostArrays":
         """Arrow → host numpy exactly once; epochs reshuffle indices only.
+        Re-fitting the same Dataset (retries, hyperparameter sweeps, repeated
+        benchmarking) reuses the staged arrays — keyed by dataset identity +
+        column selection, invalidated when the block list changes.
 
         Multi-process (one process per TPU host): each process stages only its
         equal-share shard — ``device_put_batch`` then assembles the global
         batch from per-process rows (make_array_from_process_local_data)."""
         import jax
 
+        key = (
+            getattr(ds, "uuid", None),
+            tuple(getattr(b, "object_id", id(b)) for b in getattr(ds, "blocks", [])),
+            tuple(self.feature_columns),
+            self.label_column,
+            np.dtype(self.feature_dtype).str,
+            np.dtype(self.label_dtype).str,
+            jax.process_index(),
+            jax.process_count(),
+        )
+        cache = getattr(self, "_stage_cache", None)
+        if cache is None:
+            cache = self._stage_cache = {}
+        if key in cache:
+            return cache[key]
         features, labels = ds.to_numpy(
             self.feature_columns,
             self.label_column,
@@ -261,7 +286,16 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             idx = (np.arange(per) + jax.process_index() * per) % n
             features = features[idx]
             labels = labels[idx] if labels is not None else None
-        return _HostArrays(features, labels)
+        staged = _HostArrays(features, labels)
+        while len(cache) >= 4:  # bounded: train + eval + headroom
+            cache.pop(next(iter(cache)))
+        cache[key] = staged
+        return staged
+
+    def clear_staging_cache(self) -> None:
+        """Release the staged host arrays (they can be dataset-sized; the
+        cache otherwise lives as long as the estimator)."""
+        self._stage_cache = {}
 
     # ------------------------------------------------------------------
     # fit
@@ -477,6 +511,12 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             params, opt_state, loss_sum, x, y
                         )
                     steps += 1
+                    if (
+                        self.sync_every_steps
+                        and steps % self.sync_every_steps == 0
+                    ):
+                        # bounded pipeline bubble; see __init__ comment
+                        jax.block_until_ready(loss_sum)
                 # defer the host read: float(loss_sum) here would sync the
                 # pipeline every epoch; store the device scalar instead
                 record: Dict[str, Any] = {
@@ -498,7 +538,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             loss_sum, steps = record["train_loss"]
             record["train_loss"] = float(loss_sum) / max(steps, 1)
         self._module = module
-        self._params = jax.device_get(params)
+        # keep params ON DEVICE: a device_get here drags the full parameter
+        # set (MBs of embedding tables for DLRM) through the host transfer
+        # path every fit; apply/evaluate are faster with device params, and
+        # checkpointing does its own device_get
+        self._params = params
         return self._history
 
     def _epoch_batches(self, source, batch_size, seed, shuffle=None):

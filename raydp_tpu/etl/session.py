@@ -117,9 +117,14 @@ class EtlSession:
             self._owns_pg = True
         self._bundle_indexes = placement_group_bundle_indexes
 
-        # master actor: named, long-lived ownership target
+        # master actor: named, long-lived ownership target. ETL/storage
+        # actors run Arrow kernels only — never jax — so they start "light"
+        # (python -S, skipping sitecustomize's ~2.6s jax+TPU preimport;
+        # override with etl.actor.light=False for jax-using UDFs)
+        self._light_actors = bool(self.configs.get("etl.actor.light", True))
         self.master = cluster.spawn(
-            ObjectHolder, name=f"{app_name}{MASTER_ACTOR_SUFFIX}", max_restarts=0
+            ObjectHolder, name=f"{app_name}{MASTER_ACTOR_SUFFIX}",
+            max_restarts=0, light=self._light_actors,
         )
 
         # executor pool: restartable actors (parity: setMaxRestarts(3),
@@ -150,6 +155,7 @@ class EtlSession:
                         placement_group=self._pg.id if self._pg else None,
                         bundle_index=bundle,
                         block=False,
+                        light=self._light_actors,
                     )
                     break
                 except ClusterError:
@@ -264,6 +270,7 @@ class EtlSession:
                 memory=float(self.executor_memory),
                 max_restarts=3,
                 max_concurrency=max(2, self.executor_cores + 1),
+                light=self._light_actors,
             )
             self.executors.append(handle)
         self._planner.executors = list(self.executors)
