@@ -1,0 +1,285 @@
+"""Node agent: one per (real or simulated) additional host.
+
+The reference's substrate runs actors on many physical machines through Ray's
+per-node raylet (SURVEY.md L1); this is that role for the native runtime. An
+agent process:
+
+- registers its node (resources, IP, shm namespace) with the head over TCP;
+- forks/kills actor worker processes on ITS host when the head schedules
+  actors there (the spec ships in the RPC — no shared filesystem assumed);
+- serves its node's /dev/shm blocks to remote readers (the data-plane pull
+  path: parity with the reference's cross-node plasma reads / the
+  RayDatasetRDD owner-IP locality machinery, ObjectStoreReader.scala:34-56);
+- watches its children and reports deaths so the head can restart actors
+  with the same identity.
+
+On one machine an agent with its own shm NAMESPACE stands in for a separate
+host: namespaced objects are never mapped directly by other nodes' processes
+— every cross-node read exercises the same network pull path a real
+multi-host deployment uses.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import socketserver
+import subprocess
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+import cloudpickle
+
+from raydp_tpu.cluster.common import (
+    HEAD_ADDR_ENV,
+    SESSION_ENV,
+    SHM_NS_ENV,
+    ActorSpec,
+    ClusterError,
+    recv_frame,
+    rpc,
+    send_frame,
+)
+
+
+class _ChildProc:
+    def __init__(self, proc: subprocess.Popen, incarnation: int):
+        self.proc = proc
+        self.incarnation = incarnation
+
+
+class NodeAgent:
+    def __init__(
+        self,
+        head_addr: str,
+        node_ip: str,
+        resources: Dict[str, float],
+        shm_ns: str,
+        local_dir: str,
+    ):
+        self.head_addr = head_addr
+        self.node_ip = node_ip
+        self.resources = dict(resources)
+        self.shm_ns = shm_ns
+        self.local_dir = local_dir
+        os.makedirs(local_dir, exist_ok=True)
+        self.children: Dict[str, _ChildProc] = {}
+        self.lock = threading.RLock()
+        self.stopping = False
+        self.addr: Optional[str] = None
+        self.node_id: Optional[str] = None
+        self.stats = {"spawned": 0, "blocks_served": 0, "bytes_served": 0}
+
+    # ---------- handlers (same frame protocol as head/actors) ----------
+
+    def handle_ping(self):
+        return "pong"
+
+    def handle_stats(self):
+        with self.lock:
+            return dict(self.stats)
+
+    def handle_spawn_actor(self, spec: ActorSpec, incarnation: int, head_addr: str):
+        """Fork the worker on THIS host. The spec arrives in the RPC and is
+        written to the agent's local dir — no shared filesystem with the head
+        is assumed (the head-local path writes it to the session dir)."""
+        spec_path = os.path.join(self.local_dir, f"a-{spec.actor_id}.spec")
+        with open(spec_path + ".tmp", "wb") as f:
+            cloudpickle.dump(spec, f)
+        os.replace(spec_path + ".tmp", spec_path)
+
+        env = dict(os.environ)
+        env.update(spec.env)
+        env[SESSION_ENV] = self.local_dir
+        env[HEAD_ADDR_ENV] = head_addr or self.head_addr
+        env[SHM_NS_ENV] = self.shm_ns
+        from raydp_tpu.cluster.common import TOKEN_ENV
+
+        if os.environ.get(TOKEN_ENV):  # workers authenticate over TCP too
+            env[TOKEN_ENV] = os.environ[TOKEN_ENV]
+        env["RAYDP_TPU_ACTOR_ID"] = spec.actor_id
+        env["RAYDP_TPU_NODE_ID"] = self.node_id or ""
+        env["RAYDP_TPU_NODE_IP"] = self.node_ip
+        env["RAYDP_TPU_TCP"] = "1"  # actors must be reachable across hosts
+        log_base = os.path.join(self.local_dir, f"a-{spec.actor_id}-{incarnation}")
+        with open(log_base + ".out", "ab") as out, open(log_base + ".err", "ab") as err:
+            proc = subprocess.Popen(
+                [sys.executable]
+                + (["-S"] if getattr(spec, "light", True) else [])
+                + [
+                    "-m",
+                    "raydp_tpu.cluster.worker",
+                    self.local_dir,
+                    spec.actor_id,
+                    str(incarnation),
+                ],
+                stdout=out,
+                stderr=err,
+                env=env,
+                start_new_session=True,
+            )
+        with self.lock:
+            self.children[spec.actor_id] = _ChildProc(proc, incarnation)
+            self.stats["spawned"] += 1
+        return True
+
+    def handle_kill_actor(self, actor_id: str):
+        with self.lock:
+            child = self.children.get(actor_id)
+        if child is not None and child.proc.poll() is None:
+            try:
+                os.killpg(child.proc.pid, signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+        return True
+
+    def handle_block_fetch(self, shm_name: str, offset: int = 0, length: int = -1):
+        from raydp_tpu.cluster.common import safe_shm_name
+
+        path = os.path.join("/dev/shm", safe_shm_name(shm_name))
+        with open(path, "rb") as f:
+            f.seek(offset)
+            data = f.read() if length < 0 else f.read(length)
+        with self.lock:
+            self.stats["blocks_served"] += 1
+            self.stats["bytes_served"] += len(data)
+        return data
+
+    def handle_unlink_shm(self, shm_names: List[str]):
+        from raydp_tpu.cluster.common import safe_shm_name
+
+        for name in shm_names:
+            try:
+                os.unlink(os.path.join("/dev/shm", safe_shm_name(name)))
+            except (OSError, ClusterError):
+                pass
+        return True
+
+    def handle_stop(self):
+        self.stopping = True
+        with self.lock:
+            for child in self.children.values():
+                if child.proc.poll() is None:
+                    try:
+                        os.killpg(child.proc.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+        return True
+
+    # ---------- lifecycle ----------
+
+    def monitor_loop(self):
+        """Report child deaths so the head can run its restart bookkeeping
+        (identical semantics to the head's local proc.poll monitoring), and
+        watch head liveness: an agent must not outlive its cluster."""
+        last_head_ok = time.monotonic()
+        last_ping = 0.0
+        while not self.stopping:
+            time.sleep(0.05)
+            dead = []
+            with self.lock:
+                for actor_id, child in list(self.children.items()):
+                    if child.proc.poll() is not None:
+                        dead.append((actor_id, child.incarnation))
+                        del self.children[actor_id]
+            for actor_id, incarnation in dead:
+                try:
+                    rpc(
+                        self.head_addr,
+                        (
+                            "actor_exited",
+                            {"actor_id": actor_id, "incarnation": incarnation},
+                        ),
+                        timeout=10,
+                    )
+                    last_head_ok = time.monotonic()
+                except Exception:
+                    pass
+            now = time.monotonic()
+            if now - last_ping >= 2.0:
+                last_ping = now
+                try:
+                    rpc(self.head_addr, ("ping", {}), timeout=5)
+                    last_head_ok = now
+                except Exception:
+                    pass
+            if now - last_head_ok > 15.0:
+                # head gone: tear down children and exit (parity: Ray nodes
+                # die with their GCS; prevents orphaned agent processes)
+                self.handle_stop()
+                return
+
+    def serve(self):
+        agent = self
+
+        from raydp_tpu.cluster.common import session_token, verify_token
+
+        token = session_token()
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                if not verify_token(self.request, token):
+                    return
+                try:
+                    method, kwargs = recv_frame(self.request)
+                except (ConnectionError, EOFError):
+                    return
+                try:
+                    fn = getattr(agent, f"handle_{method}", None)
+                    if fn is None:
+                        raise ClusterError(f"unknown agent method {method!r}")
+                    reply = ("ok", fn(**kwargs))
+                except BaseException as exc:  # noqa: BLE001
+                    reply = ("err", exc)
+                try:
+                    send_frame(self.request, reply)
+                except (ConnectionError, BrokenPipeError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        server = Server(("0.0.0.0", 0), Handler)
+        self.addr = f"tcp://{self.node_ip}:{server.server_address[1]}"
+        self.node_id = rpc(
+            self.head_addr,
+            (
+                "register_agent",
+                {
+                    "resources": self.resources,
+                    "node_ip": self.node_ip,
+                    "agent_addr": self.addr,
+                    "shm_ns": self.shm_ns,
+                },
+            ),
+            timeout=30,
+        )
+        # publish readiness for whoever launched us
+        ready = os.path.join(self.local_dir, "agent_ready.json")
+        with open(ready + ".tmp", "w") as f:
+            json.dump({"addr": self.addr, "node_id": self.node_id}, f)
+        os.replace(ready + ".tmp", ready)
+        threading.Thread(target=self.monitor_loop, daemon=True).start()
+        server.timeout = 0.2
+        try:
+            while not self.stopping:
+                server.handle_request()
+        finally:
+            server.server_close()
+
+
+def main() -> None:
+    head_addr, node_ip, shm_ns, local_dir, resources_json = sys.argv[1:6]
+    agent = NodeAgent(
+        head_addr, node_ip, json.loads(resources_json), shm_ns, local_dir
+    )
+    agent.serve()
+
+
+if __name__ == "__main__":
+    main()

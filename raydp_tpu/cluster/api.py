@@ -24,6 +24,7 @@ import cloudpickle
 
 from raydp_tpu.cluster.common import (
     DRIVER_OWNER,
+    HEAD_TCP_FILE,
     SESSION_ENV,
     ActorDiedError,
     ActorRecord,
@@ -34,6 +35,7 @@ from raydp_tpu.cluster.common import (
     connect,
     head_sock_path,
     recv_frame,
+    resolve_head_addr,
     rpc,
     send_frame,
     wait_for_path,
@@ -66,7 +68,7 @@ def session_dir() -> str:
 
 
 def head_rpc(method: str, timeout: float = 60.0, **kwargs) -> Any:
-    return rpc(head_sock_path(session_dir()), (method, kwargs), timeout=timeout)
+    return rpc(resolve_head_addr(session_dir()), (method, kwargs), timeout=timeout)
 
 
 def init(
@@ -104,6 +106,12 @@ def init(
             env=head_env,
         )
         wait_for_path(head_sock_path(_session_dir), 30, "head socket")
+        # adopt the cluster token into the environment so this process (and
+        # every subprocess it starts — agents, SPMD launchers) can
+        # authenticate over the TCP transport
+        from raydp_tpu.cluster.common import TOKEN_ENV, load_token
+
+        os.environ[TOKEN_ENV] = load_token(_session_dir).hex()
         atexit.register(shutdown)
         return _session_dir
 
@@ -126,6 +134,12 @@ def shutdown() -> None:
             except subprocess.TimeoutExpired:
                 _head_proc.kill()
             _head_proc = None
+        for proc in _agent_procs:
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        _agent_procs.clear()
         _session_dir = None
 
 
@@ -237,7 +251,7 @@ class ActorHandle:
 
     def _record(self) -> Optional[ActorRecord]:
         return rpc(
-            head_sock_path(self._session_dir),
+            resolve_head_addr(self._session_dir),
             ("get_actor", {"actor_id": self._actor_id}),
             timeout=30,
         )
@@ -321,7 +335,7 @@ class ActorHandle:
 
     def kill(self, no_restart: bool = True) -> None:
         rpc(
-            head_sock_path(self._session_dir),
+            resolve_head_addr(self._session_dir),
             ("kill_actor", {"actor_id": self._actor_id, "no_restart": no_restart}),
             timeout=30,
         )
@@ -423,6 +437,68 @@ def placement_group_table() -> Dict[str, Any]:
 
 
 # ---------- nodes / resources ----------
+
+
+def head_tcp_addr(timeout: float = 30.0) -> str:
+    """The head's TCP address (published in the session dir at startup) —
+    what node agents on other hosts connect to."""
+    path = os.path.join(session_dir(), HEAD_TCP_FILE)
+    wait_for_path(path, timeout, "head TCP address")
+    with open(path) as f:
+        return f.read().strip()
+
+
+def start_node_agent(
+    resources: Dict[str, float],
+    node_ip: Optional[str] = None,
+    shm_ns: Optional[str] = None,
+    head_addr: Optional[str] = None,
+    timeout: float = 60.0,
+) -> Dict[str, str]:
+    """Launch a node agent as a detached process and wait for it to register.
+
+    On a real deployment each host runs
+    ``python -m raydp_tpu.cluster.agent <head_tcp> <ip> <ns> <dir> <json>``;
+    this helper starts one on the local machine — with its own shm NAMESPACE,
+    so it behaves exactly like a separate host: none of its blocks can be
+    mapped by other nodes, every cross-node read goes over TCP.
+
+    Returns ``{"node_id", "addr", "dir"}``.
+    """
+    import json
+
+    head = head_addr or head_tcp_addr()
+    ns = shm_ns or f"n{uuid.uuid4().hex[:6]}"
+    ip = node_ip or "127.0.0.1"
+    local_dir = tempfile.mkdtemp(prefix=f"agent-{ns}-", dir=session_dir())
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+    proc = subprocess.Popen(
+        [
+            sys.executable, "-S", "-m", "raydp_tpu.cluster.agent",
+            head, ip, ns, local_dir, json.dumps(resources),
+        ],
+        start_new_session=True,
+        env=env,
+    )
+    _agent_procs.append(proc)
+    ready = os.path.join(local_dir, "agent_ready.json")
+    try:
+        wait_for_path(ready, timeout, "node agent registration")
+    except ClusterError:
+        # a half-started agent must not register later as a ghost node
+        proc.kill()
+        raise
+    with open(ready) as f:
+        info = json.load(f)
+    info["dir"] = local_dir
+    info["pid"] = proc.pid
+    return info
+
+
+# agent processes this driver started (reaped at shutdown so exited agents
+# don't linger as zombies)
+_agent_procs: List[subprocess.Popen] = []
 
 
 def add_node(resources: Dict[str, float], node_ip: Optional[str] = None) -> str:

@@ -26,8 +26,14 @@ _LEN = struct.Struct(">I")
 MAX_FRAME = 1 << 30
 
 HEAD_SOCK_NAME = "head.sock"
+HEAD_TCP_FILE = "head_tcp.addr"
+TOKEN_FILE = "cluster.token"
 SESSION_ENV = "RAYDP_TPU_SESSION"
+HEAD_ADDR_ENV = "RAYDP_TPU_HEAD_ADDR"
+SHM_NS_ENV = "RAYDP_TPU_SHM_NS"
+TOKEN_ENV = "RAYDP_TPU_TOKEN"
 DRIVER_OWNER = "__driver__"
+TOKEN_LEN = 32
 
 
 class ClusterError(RuntimeError):
@@ -74,11 +80,84 @@ def recv_frame(sock: socket.socket) -> Any:
     return cloudpickle.loads(_recv_exact(sock, length))
 
 
-def connect(sock_path: str, timeout: Optional[float] = None) -> socket.socket:
+def session_token() -> bytes:
+    """The cluster's shared secret. TCP peers must present it before any
+    frame is parsed — without it, a reachable port would mean arbitrary
+    unpickling (RCE) for anyone on the network. Resolution: env (remote
+    processes) → the session dir's token file (head-local processes)."""
+    env_token = os.environ.get(TOKEN_ENV)
+    if env_token:
+        return bytes.fromhex(env_token)
+    session = os.environ.get(SESSION_ENV)
+    if session:
+        path = os.path.join(session, TOKEN_FILE)
+        if os.path.exists(path):
+            with open(path, "rb") as f:
+                return f.read()
+    return b"\0" * TOKEN_LEN  # no session context: deliberately non-matching
+
+
+def load_token(session_dir: str) -> bytes:
+    with open(os.path.join(session_dir, TOKEN_FILE), "rb") as f:
+        return f.read()
+
+
+def verify_token(sock: socket.socket, expected: bytes) -> bool:
+    """Server side of the TCP handshake: read and compare the secret before
+    any frame touches cloudpickle."""
+    import hmac
+
+    try:
+        presented = _recv_exact(sock, TOKEN_LEN)
+    except (ConnectionError, OSError):
+        return False
+    return hmac.compare_digest(presented, expected)
+
+
+def connect(addr: str, timeout: Optional[float] = None) -> socket.socket:
+    """Connect to either transport: ``tcp://host:port`` or a Unix socket
+    path. The TCP side is what makes the substrate multi-host — agents and
+    their actors on other machines are addressed exactly like local ones.
+    TCP connections start with the session-token handshake; Unix sockets are
+    guarded by the session dir's filesystem permissions instead."""
+    if addr.startswith("tcp://"):
+        host, _, port = addr[6:].rpartition(":")
+        sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        sock.settimeout(timeout)
+        sock.connect((host, int(port)))
+        sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        sock.sendall(session_token())
+        return sock
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.settimeout(timeout)
-    sock.connect(sock_path)
+    sock.connect(addr)
     return sock
+
+
+def safe_shm_name(shm_name: str) -> str:
+    """Reject anything but a flat segment name (a client-supplied name is
+    joined under /dev/shm — path traversal must be impossible)."""
+    name = shm_name.lstrip("/")
+    if not name or "/" in name or ".." in name or not name.startswith("rtpu-"):
+        raise ClusterError(f"invalid shm segment name {shm_name!r}")
+    return name
+
+
+def resolve_head_addr(session_dir: str) -> str:
+    """The head's address for THIS process: remote processes (spawned via a
+    node agent) carry it in the environment; head-local ones use the Unix
+    socket in the session dir."""
+    env_addr = os.environ.get(HEAD_ADDR_ENV)
+    if env_addr:
+        return env_addr
+    return head_sock_path(session_dir)
+
+
+def shm_namespace() -> str:
+    """This process's shared-memory namespace (one per node). Objects are
+    only mapped directly when their namespace matches; everything else goes
+    through the owning node's block server."""
+    return os.environ.get(SHM_NS_ENV, "")
 
 
 def rpc(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) -> Any:
@@ -146,6 +225,12 @@ class NodeRecord:
     node_ip: str
     resources: Dict[str, float]
     alive: bool = True
+    # agent-backed nodes (real multi-host): the head spawns/kills actors and
+    # fetches blocks through the agent's TCP address; shm_ns is the node's
+    # shared-memory namespace (objects from other namespaces must be pulled
+    # over the network, never mapped)
+    agent_addr: Optional[str] = None
+    shm_ns: str = ""
 
 
 def actor_sock_path(session_dir: str, actor_id: str, incarnation: int) -> str:

@@ -27,6 +27,7 @@ import uuid
 from typing import Any, Dict, List, Optional
 
 from raydp_tpu.cluster.common import (
+    HEAD_TCP_FILE,
     SESSION_ENV,
     ActorDiedError,
     ActorRecord,
@@ -39,6 +40,7 @@ from raydp_tpu.cluster.common import (
     connect,
     head_sock_path,
     recv_frame,
+    rpc,
     send_frame,
 )
 
@@ -117,12 +119,19 @@ class Head:
         self.objects: Dict[str, _ObjectMeta] = {}
         self.shutting_down = False
         self._next_ip = 2
+        self.tcp_addr: Optional[str] = None  # set by run_head once bound
         if default_resources:
             self._add_node(default_resources)
 
     # ---------- nodes ----------
 
-    def _add_node(self, resources: Dict[str, float], node_ip: Optional[str] = None) -> str:
+    def _add_node(
+        self,
+        resources: Dict[str, float],
+        node_ip: Optional[str] = None,
+        agent_addr: Optional[str] = None,
+        shm_ns: str = "",
+    ) -> str:
         node_id = f"node-{uuid.uuid4().hex[:8]}"
         if node_ip is None:
             node_ip = f"127.0.0.{self._next_ip}"
@@ -131,13 +140,31 @@ class Head:
         res.setdefault("CPU", 1.0)
         res.setdefault("memory", float(4 << 30))
         res[f"node:{node_ip}"] = 1.0
-        self.nodes[node_id] = NodeRecord(node_id, node_ip, res)
+        self.nodes[node_id] = NodeRecord(
+            node_id, node_ip, res, agent_addr=agent_addr, shm_ns=shm_ns
+        )
         self.node_available[node_id] = dict(res)
         return node_id
 
     def handle_add_node(self, resources: Dict[str, float], node_ip: Optional[str] = None):
         with self.lock:
             return self._add_node(resources, node_ip)
+
+    def handle_register_agent(
+        self,
+        resources: Dict[str, float],
+        node_ip: str,
+        agent_addr: str,
+        shm_ns: str,
+    ):
+        """A node agent (another host, or a separate-shm process standing in
+        for one) joins the cluster: its actors spawn through the agent and
+        its blocks are served by the agent's block server — the multi-host
+        parity of the reference's Ray nodes (SURVEY.md L1)."""
+        with self.lock:
+            return self._add_node(
+                resources, node_ip, agent_addr=agent_addr, shm_ns=shm_ns
+            )
 
     def handle_remove_node(self, node_id: str):
         """Kill a virtual node and every actor process on it (elasticity testing,
@@ -155,7 +182,12 @@ class Head:
                     ActorState.PENDING,
                 ):
                     self._kill_proc(actor)
-            # the monitor thread observes the deaths and handles restart/cleanup
+                    if actor.proc is None:
+                        # agent-hosted actor: there is no local proc for the
+                        # monitor to observe (and a dead agent will never
+                        # report) — recycle it here
+                        self._on_actor_death(actor)
+            # the monitor observes local-proc deaths and handles restart/cleanup
         return True
 
     def handle_nodes(self):
@@ -336,6 +368,41 @@ class Head:
     def _spawn(self, actor: _Actor) -> None:
         spec = actor.spec
         node = self.nodes[actor.node_id]
+        if node.agent_addr is not None:
+            # remote node: the agent forks the worker on its host. The RPC
+            # runs on a thread — _spawn is called under the head lock, and a
+            # slow/dead agent must not freeze the whole control plane. A
+            # failed delivery flips the actor back to pending_respawn, which
+            # the monitor retries (and the agent watchdog will kill the node
+            # if it stays unreachable).
+            agent_addr = node.agent_addr
+            incarnation = actor.incarnation
+            head_addr = self.tcp_addr
+
+            def _remote_spawn():
+                try:
+                    rpc(
+                        agent_addr,
+                        (
+                            "spawn_actor",
+                            {
+                                "spec": spec,
+                                "incarnation": incarnation,
+                                "head_addr": head_addr,
+                            },
+                        ),
+                        timeout=15,
+                    )
+                except Exception:
+                    with self.lock:
+                        if actor.incarnation == incarnation and actor.state not in (
+                            ActorState.DEAD,
+                        ):
+                            actor.pending_respawn = True
+
+            threading.Thread(target=_remote_spawn, daemon=True).start()
+            actor.proc = None
+            return
         log_base = os.path.join(
             self.session_dir, f"a-{spec.actor_id}-{actor.incarnation}"
         )
@@ -436,6 +503,20 @@ class Head:
                 for a in self.actors.values()
             ]
 
+    def handle_actor_exited(self, actor_id: str, incarnation: int):
+        """Agent-reported death of a remote actor (local actors are observed
+        directly via proc.poll in the monitor loop)."""
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if (
+                actor is not None
+                and actor.incarnation == incarnation
+                and actor.state not in (ActorState.DEAD,)
+                and not actor.pending_respawn
+            ):
+                self._on_actor_death(actor)
+            return True
+
     def handle_mark_intentional_exit(self, actor_id: str):
         """Called by an actor about to exit on purpose so the monitor does not
         restart it (parity: Ray.exitActor used precisely for this,
@@ -452,6 +533,24 @@ class Head:
                 os.killpg(actor.proc.pid, signal.SIGKILL)
             except (ProcessLookupError, PermissionError):
                 pass
+            return
+        if actor.proc is None and actor.node_id:
+            node = self.nodes.get(actor.node_id)
+            if node is not None and node.agent_addr is not None:
+                agent_addr = node.agent_addr
+                actor_id = actor.spec.actor_id
+
+                def _remote_kill():  # off-lock: agents can be slow/dead
+                    try:
+                        rpc(
+                            agent_addr,
+                            ("kill_actor", {"actor_id": actor_id}),
+                            timeout=10,
+                        )
+                    except Exception:
+                        pass  # agent gone: the node is dead anyway
+
+                threading.Thread(target=_remote_kill, daemon=True).start()
 
     def handle_kill_actor(self, actor_id: str, no_restart: bool = True):
         with self.lock:
@@ -479,7 +578,7 @@ class Head:
         self._release_actor_resources(actor)
         old_sock = actor.sock_path
         actor.sock_path = None
-        if old_sock:
+        if old_sock and not old_sock.startswith("tcp://"):
             try:
                 os.unlink(old_sock)
             except OSError:
@@ -528,12 +627,44 @@ class Head:
                     f"object {object_id}: owner died and the object was not "
                     "transferred before the owner exited"
                 )
+            node = self.nodes.get(meta.node_id)
+            # where a non-local reader can pull the bytes: the owning node's
+            # agent, or the head itself for head-node objects (parity:
+            # plasma locality + RayDatasetRDD owner addresses, SURVEY §2.2 S8)
+            if node is not None and node.agent_addr is not None:
+                shm_ns, fetch_addr = node.shm_ns, node.agent_addr
+            else:
+                shm_ns, fetch_addr = "", self.tcp_addr
             return {
                 "shm_name": meta.shm_name,
                 "size": meta.size,
                 "owner": meta.owner,
                 "node_id": meta.node_id,
+                "shm_ns": shm_ns,
+                "fetch_addr": fetch_addr,
             }
+
+    def handle_object_locations(self, object_ids: List[str]):
+        """Batch block→node lookup for locality-aware task dispatch (parity:
+        getPreferredLocations, reference RayDatasetRDD.scala:53-55)."""
+        with self.lock:
+            return {
+                oid: self.objects[oid].node_id
+                for oid in object_ids
+                if oid in self.objects and not self.objects[oid].owner_died
+            }
+
+    def handle_block_fetch(self, shm_name: str, offset: int = 0, length: int = -1):
+        """Serve a head-node block's bytes to a remote reader (the head plays
+        block server for namespace-'' objects; agents serve their own).
+        ``offset``/``length`` let readers pull huge blocks in chunks under
+        the frame-size cap."""
+        from raydp_tpu.cluster.common import safe_shm_name
+
+        path = os.path.join("/dev/shm", safe_shm_name(shm_name))
+        with open(path, "rb") as f:
+            f.seek(offset)
+            return f.read() if length < 0 else f.read(length)
 
     def handle_object_transfer_owner(self, object_ids: List[str], new_owner: str):
         """Ownership transfer: data outlives the engine that produced it
@@ -548,11 +679,38 @@ class Head:
 
     def handle_object_delete(self, object_ids: List[str]):
         with self.lock:
-            for object_id in object_ids:
-                meta = self.objects.pop(object_id, None)
-                if meta is not None:
-                    self._unlink_shm(meta.shm_name)
-            return True
+            metas = [
+                meta
+                for object_id in object_ids
+                if (meta := self.objects.pop(object_id, None)) is not None
+            ]
+        self._unlink_objects(metas)
+        return True
+
+    def _unlink_objects(self, metas: List["_ObjectMeta"], wait: bool = False) -> None:
+        """Release segments, routing remote-node objects through their agent.
+        Never called under the lock (agent RPCs can be slow). ``wait=True``
+        (shutdown path) performs the agent RPCs synchronously — fire-and-
+        forget threads would race the agents' own teardown and leak
+        /dev/shm segments."""
+        by_agent: Dict[str, List[str]] = {}
+        for meta in metas:
+            node = self.nodes.get(meta.node_id)
+            if node is not None and node.agent_addr is not None:
+                by_agent.setdefault(node.agent_addr, []).append(meta.shm_name)
+            else:
+                self._unlink_shm(meta.shm_name)
+        for agent_addr, names in by_agent.items():
+            def _fire(addr=agent_addr, shm_names=names):
+                try:
+                    rpc(addr, ("unlink_shm", {"shm_names": shm_names}), timeout=10)
+                except Exception:
+                    pass  # agent gone: its /dev/shm died with the node
+
+            if wait:
+                _fire()
+            else:
+                threading.Thread(target=_fire, daemon=True).start()
 
     def handle_object_owner_of(self, object_id: str):
         with self.lock:
@@ -567,10 +725,17 @@ class Head:
             pass
 
     def _on_owner_dead(self, owner: str) -> None:
+        dead = []
         for meta in self.objects.values():
             if meta.owner == owner and not meta.owner_died:
                 meta.owner_died = True
-                self._unlink_shm(meta.shm_name)
+                dead.append(meta)
+        if dead:
+            # called under the lock (monitor/death paths): release segments
+            # from a thread so a slow/dead agent can't stall the head
+            threading.Thread(
+                target=self._unlink_objects, args=(dead,), daemon=True
+            ).start()
 
     # ---------- lifecycle ----------
 
@@ -583,12 +748,22 @@ class Head:
             for actor in self.actors.values():
                 actor.intentional_exit = True
                 self._kill_proc(actor)
-            for meta in self.objects.values():
-                self._unlink_shm(meta.shm_name)
+            metas = list(self.objects.values())
             self.objects.clear()
+            agents = [
+                n.agent_addr for n in self.nodes.values() if n.agent_addr
+            ]
+        self._unlink_objects(metas, wait=True)
+        for agent_addr in agents:
+            try:
+                rpc(agent_addr, ("stop", {}), timeout=5)
+            except Exception:
+                pass  # the agent's own head-liveness watchdog will exit it
         return True
 
     def monitor_loop(self) -> None:
+        agent_last_ok: Dict[str, float] = {}
+        last_agent_probe = 0.0
         while not self.shutting_down:
             time.sleep(0.05)
             with self.lock:
@@ -600,6 +775,32 @@ class Head:
                         continue
                     if actor.proc is not None and actor.proc.poll() is not None:
                         self._on_actor_death(actor)
+            # agent liveness: agents watch the head, the head watches agents.
+            # An unreachable agent (crashed host) gets its node marked dead
+            # and its actors recycled — otherwise they'd stay ALIVE forever
+            # and callers would hang retrying a dead tcp:// address.
+            now = time.monotonic()
+            if now - last_agent_probe >= 2.0:
+                last_agent_probe = now
+                with self.lock:
+                    agent_nodes = [
+                        (n.node_id, n.agent_addr)
+                        for n in self.nodes.values()
+                        if n.alive and n.agent_addr is not None
+                    ]
+                for node_id, agent_addr in agent_nodes:
+                    try:
+                        rpc(agent_addr, ("ping", {}), timeout=3)
+                        agent_last_ok[node_id] = now
+                    except Exception:
+                        if now - agent_last_ok.get(node_id, now) > 15.0:
+                            try:
+                                self.handle_remove_node(node_id)
+                            except ClusterError:
+                                pass
+                            agent_last_ok.pop(node_id, None)
+                        else:
+                            agent_last_ok.setdefault(node_id, now)
             # driver liveness: tear everything down if the driver is gone
             if self.driver_pid and not _pid_alive(self.driver_pid):
                 self.handle_shutdown()
@@ -619,6 +820,12 @@ def _pid_alive(pid: int) -> bool:
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         head: Head = self.server.head  # type: ignore[attr-defined]
+        token = getattr(self.server, "token", None)
+        if token is not None:  # TCP: authenticate before any unpickling
+            from raydp_tpu.cluster.common import verify_token
+
+            if not verify_token(self.request, token):
+                return
         try:
             method, kwargs = recv_frame(self.request)
         except (ConnectionError, EOFError):
@@ -643,10 +850,49 @@ class _Server(socketserver.ThreadingUnixStreamServer):
     allow_reuse_address = True
 
 
+class _TcpServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _advertised_ip() -> str:
+    """The IP other hosts can reach this head on (best effort; loopback when
+    the host has no external route — single-machine sessions)."""
+    try:
+        probe = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        probe.connect(("8.8.8.8", 80))
+        ip = probe.getsockname()[0]
+        probe.close()
+        return ip
+    except OSError:
+        return "127.0.0.1"
+
+
 def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, float]) -> None:
     head = Head(session_dir, driver_pid, default_resources)
     server = _Server(head_sock_path(session_dir), _Handler)
     server.head = head  # type: ignore[attr-defined]
+    # TCP beside the Unix socket: node agents (and their actors) on other
+    # hosts address the head through this; the bound address is published in
+    # the session dir for local discovery and passed by env to remote actors
+    tcp_server = _TcpServer(("0.0.0.0", 0), _Handler)
+    tcp_server.head = head  # type: ignore[attr-defined]
+    from raydp_tpu.cluster.common import TOKEN_ENV, load_token
+
+    token = load_token(session_dir)
+    tcp_server.token = token  # type: ignore[attr-defined]
+    # the head itself dials TCP peers (agents) and its env predates the
+    # token file — adopt it so outgoing connects authenticate; worker spawns
+    # inherit it too
+    os.environ[TOKEN_ENV] = token.hex()
+    head.tcp_addr = f"tcp://{_advertised_ip()}:{tcp_server.server_address[1]}"
+    tcp_path = os.path.join(session_dir, HEAD_TCP_FILE)
+    with open(tcp_path + ".tmp", "w") as f:
+        f.write(head.tcp_addr)
+    os.replace(tcp_path + ".tmp", tcp_path)
+    threading.Thread(
+        target=tcp_server.serve_forever, kwargs={"poll_interval": 0.2}, daemon=True
+    ).start()
     monitor = threading.Thread(target=head.monitor_loop, name="monitor", daemon=True)
     monitor.start()
     server.timeout = 0.2
@@ -655,3 +901,5 @@ def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, flo
             server.handle_request()
     finally:
         server.server_close()
+        tcp_server.shutdown()
+        tcp_server.server_close()

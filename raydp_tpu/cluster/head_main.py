@@ -1,10 +1,12 @@
 """``python -m raydp_tpu.cluster.head_main <session_dir>`` — head process entry."""
 
 import os
+import secrets
 import sys
 
 import cloudpickle
 
+from raydp_tpu.cluster.common import TOKEN_FILE, TOKEN_LEN
 from raydp_tpu.cluster.head import run_head
 
 
@@ -12,6 +14,13 @@ def main() -> None:
     session_dir = sys.argv[1]
     with open(os.path.join(session_dir, "head_boot.pkl"), "rb") as f:
         driver_pid, default_resources = cloudpickle.load(f)
+    # the cluster's shared secret, written before any socket exists; the
+    # session dir is mkdtemp(0700) so only the session's user can read it
+    token_path = os.path.join(session_dir, TOKEN_FILE)
+    if not os.path.exists(token_path):
+        with open(token_path + ".tmp", "wb") as f:
+            f.write(secrets.token_bytes(TOKEN_LEN))
+        os.replace(token_path + ".tmp", token_path)
     run_head(session_dir, driver_pid, default_resources)
 
 

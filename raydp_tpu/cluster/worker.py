@@ -24,8 +24,8 @@ import cloudpickle
 
 from raydp_tpu.cluster.common import (
     actor_sock_path,
-    head_sock_path,
     recv_frame,
+    resolve_head_addr,
     rpc,
     send_frame,
 )
@@ -57,7 +57,7 @@ def exit_actor() -> None:
         raise RuntimeError("exit_actor() called outside an actor process")
     try:
         rpc(
-            head_sock_path(ctx.session_dir),
+            resolve_head_addr(ctx.session_dir),
             ("mark_intentional_exit", {"actor_id": ctx.actor_id}),
             timeout=10,
         )
@@ -70,7 +70,21 @@ class _ActorServer(socketserver.ThreadingUnixStreamServer):
     allow_reuse_address = True
 
 
-def _serve(instance, sock_path: str, max_concurrency: int, stop_event: threading.Event):
+class _ActorTcpServer(socketserver.ThreadingTCPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+
+
+def _serve(
+    instance,
+    sock_path: str,
+    max_concurrency: int,
+    stop_event: threading.Event,
+    bound: "list",
+    bound_event: threading.Event,
+    use_tcp: bool,
+    node_ip: str,
+):
     pool = concurrent.futures.ThreadPoolExecutor(max_workers=max(1, max_concurrency))
 
     class Handler(socketserver.BaseRequestHandler):
@@ -123,7 +137,25 @@ def _serve(instance, sock_path: str, max_concurrency: int, stop_event: threading
                 except (ConnectionError, BrokenPipeError):
                     pass
 
-    server = _ActorServer(sock_path, Handler)
+    if use_tcp:
+        # agent-spawned actors must be reachable across hosts; peers
+        # authenticate with the session token before any frame is parsed
+        from raydp_tpu.cluster.common import session_token, verify_token
+
+        token = session_token()
+
+        class TcpHandler(Handler):
+            def handle(self):
+                if not verify_token(self.request, token):
+                    return
+                super().handle()
+
+        server = _ActorTcpServer(("0.0.0.0", 0), TcpHandler)
+        bound.append(f"tcp://{node_ip}:{server.server_address[1]}")
+    else:
+        server = _ActorServer(sock_path, Handler)
+        bound.append(sock_path)
+    bound_event.set()
     server.timeout = 0.2
     while not stop_event.is_set():
         server.handle_request()
@@ -135,7 +167,7 @@ def main() -> None:
     session_dir, actor_id, incarnation_str = sys.argv[1], sys.argv[2], sys.argv[3]
     incarnation = int(incarnation_str)
     _context = _WorkerContext(session_dir, actor_id, incarnation)
-    head = head_sock_path(session_dir)
+    head = resolve_head_addr(session_dir)
 
     spec_path = os.path.join(session_dir, f"a-{actor_id}.spec")
     with open(spec_path, "rb") as f:
@@ -166,23 +198,25 @@ def main() -> None:
     except OSError:
         pass
     stop_event = threading.Event()
+    bound: list = []
+    bound_event = threading.Event()
+    use_tcp = os.environ.get("RAYDP_TPU_TCP") == "1"
     server_thread = threading.Thread(
         target=_serve,
-        args=(instance, sock_path, spec.max_concurrency, stop_event),
+        args=(
+            instance, sock_path, spec.max_concurrency, stop_event,
+            bound, bound_event, use_tcp, _context.node_ip,
+        ),
         daemon=True,
     )
     server_thread.start()
-    # wait for the socket to be bound before reporting ready
-    import time
-
-    deadline = time.monotonic() + 10
-    while not os.path.exists(sock_path) and time.monotonic() < deadline:
-        time.sleep(0.005)
+    if not bound_event.wait(timeout=10):
+        raise RuntimeError("actor server failed to bind")
     rpc(
         head,
         (
             "actor_ready",
-            {"actor_id": actor_id, "incarnation": incarnation, "sock_path": sock_path},
+            {"actor_id": actor_id, "incarnation": incarnation, "sock_path": bound[0]},
         ),
         timeout=30,
     )

@@ -50,6 +50,15 @@ class SpmdWorker:
     def ping(self) -> int:
         return self.ctx.rank
 
+    def pick_free_port(self) -> int:
+        """A free TCP port on THIS rank's host (the jax.distributed
+        coordinator must bind where rank 0 actually runs)."""
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("0.0.0.0", 0))
+            return s.getsockname()[1]
+
     def bootstrap_jax_distributed(
         self, coordinator_address: str, num_processes: int, process_id: int
     ) -> int:
@@ -186,14 +195,23 @@ class SpmdJob:
 
     def bootstrap_jax(self, coordinator_port: int = 0) -> List[int]:
         """Bring up jax.distributed across all ranks; returns per-rank global
-        device counts. Rank 0's node hosts the coordinator."""
-        import socket
-
+        device counts. The coordinator binds on RANK 0's node — its address
+        is resolved from rank 0's actor record, not the driver's loopback,
+        so multi-host jobs rendezvous correctly (round-1 ADVICE: the old
+        127.0.0.1 address silently broke off the driver's host)."""
+        rank0 = self._workers[0]
+        try:
+            record = rank0._record()
+            host = record.node_ip if record and record.node_ip else "127.0.0.1"
+        except Exception:
+            host = "127.0.0.1"
         if coordinator_port == 0:
-            with socket.socket() as s:
-                s.bind(("127.0.0.1", 0))
-                coordinator_port = s.getsockname()[1]
-        address = f"127.0.0.1:{coordinator_port}"
+            # a free port on rank 0's HOST (ask the rank itself: the driver
+            # cannot probe another machine's port space)
+            coordinator_port = rank0.pick_free_port.options(
+                timeout=self.timeout
+            ).remote().result()
+        address = f"{host}:{coordinator_port}"
         futures = [
             w.bootstrap_jax_distributed.options(timeout=self.timeout).remote(
                 address, self.world_size, rank
