@@ -105,23 +105,9 @@ class NodeAgent:
         env["RAYDP_TPU_NODE_ID"] = self.node_id or ""
         env["RAYDP_TPU_NODE_IP"] = self.node_ip
         env["RAYDP_TPU_TCP"] = "1"  # actors must be reachable across hosts
-        log_base = os.path.join(self.local_dir, f"a-{spec.actor_id}-{incarnation}")
-        with open(log_base + ".out", "ab") as out, open(log_base + ".err", "ab") as err:
-            proc = subprocess.Popen(
-                [sys.executable]
-                + (["-S"] if getattr(spec, "light", True) else [])
-                + [
-                    "-m",
-                    "raydp_tpu.cluster.worker",
-                    self.local_dir,
-                    spec.actor_id,
-                    str(incarnation),
-                ],
-                stdout=out,
-                stderr=err,
-                env=env,
-                start_new_session=True,
-            )
+        from raydp_tpu.cluster.common import launch_worker
+
+        proc = launch_worker(spec, incarnation, self.local_dir, env)
         with self.lock:
             self.children[spec.actor_id] = _ChildProc(proc, incarnation)
             self.stats["spawned"] += 1
@@ -138,12 +124,9 @@ class NodeAgent:
         return True
 
     def handle_block_fetch(self, shm_name: str, offset: int = 0, length: int = -1):
-        from raydp_tpu.cluster.common import safe_shm_name
+        from raydp_tpu.cluster.common import serve_block_bytes
 
-        path = os.path.join("/dev/shm", safe_shm_name(shm_name))
-        with open(path, "rb") as f:
-            f.seek(offset)
-            data = f.read() if length < 0 else f.read(length)
+        data = serve_block_bytes(shm_name, offset, length)
         with self.lock:
             self.stats["blocks_served"] += 1
             self.stats["bytes_served"] += len(data)
@@ -185,7 +168,6 @@ class NodeAgent:
                 for actor_id, child in list(self.children.items()):
                     if child.proc.poll() is not None:
                         dead.append((actor_id, child.incarnation))
-                        del self.children[actor_id]
             for actor_id, incarnation in dead:
                 try:
                     rpc(
@@ -198,7 +180,10 @@ class NodeAgent:
                     )
                     last_head_ok = time.monotonic()
                 except Exception:
-                    pass
+                    continue  # keep the entry: retried next loop — a death
+                    # report must not be lost to a transient head blip
+                with self.lock:
+                    self.children.pop(actor_id, None)
             now = time.monotonic()
             if now - last_ping >= 2.0:
                 last_ping = now

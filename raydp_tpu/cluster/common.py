@@ -239,3 +239,39 @@ def actor_sock_path(session_dir: str, actor_id: str, incarnation: int) -> str:
 
 def head_sock_path(session_dir: str) -> str:
     return os.path.join(session_dir, HEAD_SOCK_NAME)
+
+
+def serve_block_bytes(shm_name: str, offset: int = 0, length: int = -1) -> bytes:
+    """Read a local /dev/shm segment for a remote reader (the block-server
+    primitive shared by the head and node agents — one copy of the
+    sanitize/seek/length logic)."""
+    path = os.path.join("/dev/shm", safe_shm_name(shm_name))
+    with open(path, "rb") as f:
+        f.seek(offset)
+        return f.read() if length < 0 else f.read(length)
+
+
+def launch_worker(spec, incarnation: int, run_dir: str, env: Dict[str, str]):
+    """Fork one actor worker process — the single spawn recipe used by both
+    the head (local nodes) and node agents (remote nodes): log redirection,
+    optional ``-S`` light start, detached session."""
+    import subprocess
+    import sys
+
+    log_base = os.path.join(run_dir, f"a-{spec.actor_id}-{incarnation}")
+    with open(log_base + ".out", "ab") as out, open(log_base + ".err", "ab") as err:
+        return subprocess.Popen(
+            [sys.executable]
+            + (["-S"] if getattr(spec, "light", True) else [])
+            + [
+                "-m",
+                "raydp_tpu.cluster.worker",
+                run_dir,
+                spec.actor_id,
+                str(incarnation),
+            ],
+            stdout=out,
+            stderr=err,
+            env=env,
+            start_new_session=True,
+        )

@@ -398,36 +398,23 @@ class Head:
                         if actor.incarnation == incarnation and actor.state not in (
                             ActorState.DEAD,
                         ):
+                            # credit back what _schedule charged: the retry
+                            # path re-schedules (and re-charges) from scratch
+                            self._release_actor_resources(actor)
                             actor.pending_respawn = True
 
             threading.Thread(target=_remote_spawn, daemon=True).start()
             actor.proc = None
             return
-        log_base = os.path.join(
-            self.session_dir, f"a-{spec.actor_id}-{actor.incarnation}"
-        )
         env = dict(os.environ)
         env.update(spec.env)
         env[SESSION_ENV] = self.session_dir
         env["RAYDP_TPU_ACTOR_ID"] = spec.actor_id
         env["RAYDP_TPU_NODE_ID"] = actor.node_id
         env["RAYDP_TPU_NODE_IP"] = node.node_ip
-        with open(log_base + ".out", "ab") as out, open(log_base + ".err", "ab") as err:
-            actor.proc = subprocess.Popen(
-                [sys.executable]
-                + (["-S"] if getattr(spec, "light", True) else [])
-                + [
-                    "-m",
-                    "raydp_tpu.cluster.worker",
-                    self.session_dir,
-                    spec.actor_id,
-                    str(actor.incarnation),
-                ],
-                stdout=out,
-                stderr=err,
-                env=env,
-                start_new_session=True,
-            )
+        from raydp_tpu.cluster.common import launch_worker
+
+        actor.proc = launch_worker(spec, actor.incarnation, self.session_dir, env)
 
     def handle_create_actor(self, spec: ActorSpec) -> str:
         with self.lock:
@@ -659,12 +646,9 @@ class Head:
         block server for namespace-'' objects; agents serve their own).
         ``offset``/``length`` let readers pull huge blocks in chunks under
         the frame-size cap."""
-        from raydp_tpu.cluster.common import safe_shm_name
+        from raydp_tpu.cluster.common import serve_block_bytes
 
-        path = os.path.join("/dev/shm", safe_shm_name(shm_name))
-        with open(path, "rb") as f:
-            f.seek(offset)
-            return f.read() if length < 0 else f.read(length)
+        return serve_block_bytes(shm_name, offset, length)
 
     def handle_object_transfer_owner(self, object_ids: List[str], new_owner: str):
         """Ownership transfer: data outlives the engine that produced it
@@ -762,8 +746,6 @@ class Head:
         return True
 
     def monitor_loop(self) -> None:
-        agent_last_ok: Dict[str, float] = {}
-        last_agent_probe = 0.0
         while not self.shutting_down:
             time.sleep(0.05)
             with self.lock:
@@ -775,36 +757,59 @@ class Head:
                         continue
                     if actor.proc is not None and actor.proc.poll() is not None:
                         self._on_actor_death(actor)
-            # agent liveness: agents watch the head, the head watches agents.
-            # An unreachable agent (crashed host) gets its node marked dead
-            # and its actors recycled — otherwise they'd stay ALIVE forever
-            # and callers would hang retrying a dead tcp:// address.
-            now = time.monotonic()
-            if now - last_agent_probe >= 2.0:
-                last_agent_probe = now
-                with self.lock:
-                    agent_nodes = [
-                        (n.node_id, n.agent_addr)
-                        for n in self.nodes.values()
-                        if n.alive and n.agent_addr is not None
-                    ]
-                for node_id, agent_addr in agent_nodes:
-                    try:
-                        rpc(agent_addr, ("ping", {}), timeout=3)
-                        agent_last_ok[node_id] = now
-                    except Exception:
-                        if now - agent_last_ok.get(node_id, now) > 15.0:
-                            try:
-                                self.handle_remove_node(node_id)
-                            except ClusterError:
-                                pass
-                            agent_last_ok.pop(node_id, None)
-                        else:
-                            agent_last_ok.setdefault(node_id, now)
             # driver liveness: tear everything down if the driver is gone
             if self.driver_pid and not _pid_alive(self.driver_pid):
                 self.handle_shutdown()
                 os._exit(0)
+
+    def agent_watchdog_loop(self) -> None:
+        """Agent liveness: agents watch the head, the head watches agents.
+        An unreachable agent (crashed host) gets its node marked dead and
+        its actors recycled — otherwise they'd stay ALIVE forever and
+        callers would hang retrying a dead tcp:// address. Runs on its OWN
+        thread with concurrent probes so blocking 3s pings of several dead
+        hosts cannot stall local death detection or driver teardown."""
+        agent_last_ok: Dict[str, float] = {}
+        while not self.shutting_down:
+            time.sleep(2.0)
+            with self.lock:
+                agent_nodes = [
+                    (n.node_id, n.agent_addr)
+                    for n in self.nodes.values()
+                    if n.alive and n.agent_addr is not None
+                ]
+            if not agent_nodes:
+                continue
+            now = time.monotonic()
+            results: Dict[str, bool] = {}
+
+            def probe(node_id=None, agent_addr=None):
+                try:
+                    rpc(agent_addr, ("ping", {}), timeout=3)
+                    results[node_id] = True
+                except Exception:
+                    results[node_id] = False
+
+            threads = [
+                threading.Thread(target=probe, kwargs={"node_id": nid, "agent_addr": addr})
+                for nid, addr in agent_nodes
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=5)
+            for node_id, ok in results.items():
+                if ok:
+                    agent_last_ok[node_id] = now
+                    continue
+                if now - agent_last_ok.get(node_id, now) > 15.0:
+                    try:
+                        self.handle_remove_node(node_id)
+                    except ClusterError:
+                        pass
+                    agent_last_ok.pop(node_id, None)
+                else:
+                    agent_last_ok.setdefault(node_id, now)
 
 
 def _pid_alive(pid: int) -> bool:
@@ -895,6 +900,9 @@ def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, flo
     ).start()
     monitor = threading.Thread(target=head.monitor_loop, name="monitor", daemon=True)
     monitor.start()
+    threading.Thread(
+        target=head.agent_watchdog_loop, name="agent-watchdog", daemon=True
+    ).start()
     server.timeout = 0.2
     try:
         while not head.shutting_down:

@@ -344,21 +344,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             self.resume_from_epoch = saved_resume
 
     def _latest_checkpoint_epoch(self) -> Optional[int]:
-        """Highest epoch with a committed checkpoint under checkpoint_dir
-        (orbax renames the tmp dir only after a successful commit, so a bare
-        ``epoch_N`` directory is a complete checkpoint)."""
-        import re
-
-        root = os.path.abspath(self.checkpoint_dir)
-        if not os.path.isdir(root):
-            return None
-        epochs = [
-            int(m.group(1))
-            for name in os.listdir(root)
-            for m in [re.fullmatch(r"epoch_(\d+)", name)]
-            if m and os.path.isdir(os.path.join(root, name))
-        ]
-        return max(epochs) if epochs else None
+        return latest_checkpoint_epoch(self.checkpoint_dir)
 
     def _fit_once(self, train_ds, evaluate_ds) -> List[Dict[str, float]]:
         import jax
@@ -529,9 +515,10 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                         self._evaluate_host(eval_source, params, eval_step, mesh, batch_size)
                     )
                 self._history.append(record)
-                # multi-process: only process 0 writes (concurrent orbax
-                # saves to one path race delete/write/commit)
-                if self.checkpoint_dir and jax.process_index() == 0:
+                # EVERY process calls save: orbax's Checkpointer runs
+                # cross-process barriers and writes from the primary host
+                # only — a lone process-0 save deadlocks on those barriers
+                if self.checkpoint_dir:
                     self._save_checkpoint(params, epoch, opt_state)
 
         for record in self._history:  # one sync at the end
@@ -711,6 +698,26 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
     @property
     def history(self) -> List[Dict[str, float]]:
         return self._history
+
+
+def latest_checkpoint_epoch(checkpoint_dir: Optional[str]) -> Optional[int]:
+    """Highest epoch with a committed checkpoint under checkpoint_dir
+    (orbax renames the tmp dir only after a successful commit, so a bare
+    ``epoch_N`` directory is a complete checkpoint)."""
+    import re
+
+    if not checkpoint_dir:
+        return None
+    root = os.path.abspath(checkpoint_dir)
+    if not os.path.isdir(root):
+        return None
+    epochs = [
+        int(m.group(1))
+        for name in os.listdir(root)
+        for m in [re.fullmatch(r"epoch_(\d+)", name)]
+        if m and os.path.isdir(os.path.join(root, name))
+    ]
+    return max(epochs) if epochs else None
 
 
 def _dataset_from_parquet(directory: str):

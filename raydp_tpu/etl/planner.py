@@ -88,12 +88,14 @@ class Planner:
         ``preferred`` (locality) is tried first on the initial attempt."""
         last_exc: Optional[BaseException] = None
         n = len(self.executors)
-        order = list(range(n))
+        # task-rotated fallback order either way: when the preferred
+        # executor is dead, failover spreads across the pool instead of
+        # herding every task onto executor 0
+        order = [(i + attempt + offset) % n for offset in range(n)]
         if preferred is not None and attempt == 0:
-            order.remove(preferred % n)
-            order.insert(0, preferred % n)
-        else:
-            order = [(i + attempt + offset) % n for offset in range(n)]
+            first = preferred % n
+            order.remove(first)
+            order.insert(0, first)
         for idx in order:
             try:
                 return self.executors[idx].run_task.remote(spec)

@@ -1,6 +1,7 @@
 """SPMD runtime: job launcher (MPI-on-Ray parity) + jax.distributed bootstrap."""
 
 from raydp_tpu.spmd.bootstrap import initialize_from_env, process_rank, world_size
+from raydp_tpu.spmd.elastic import elastic_fit
 from raydp_tpu.spmd.job import SpmdJob, SpmdWorker, WorkerContext, create_spmd_job
 
 __all__ = [
@@ -8,6 +9,7 @@ __all__ = [
     "SpmdWorker",
     "WorkerContext",
     "create_spmd_job",
+    "elastic_fit",
     "initialize_from_env",
     "process_rank",
     "world_size",

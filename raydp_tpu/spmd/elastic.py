@@ -1,0 +1,83 @@
+"""Elastic multi-host training: the rebuild-mesh-from-checkpoint watchdog.
+
+TPU pods fail as slices — a dead host cannot hot-swap into a running
+jax.distributed mesh, so the recovery model is: detect the dead rank, tear
+the gang down, start a fresh gang, and resume from the last committed
+checkpoint (SURVEY.md §7 hard part 3). Round 1 shipped every piece
+(restartable actors, orbax epoch checkpoints, ``resume_from_epoch``) but
+not the loop that connects them; this module is that loop.
+
+Strictly stronger than the reference's recovery story: its only elasticity
+test re-materializes converted *data* after a node kill
+(test_reconstruction, reference test_spark_cluster.py:166-196) while
+training-level failures just re-run whole trainers via Ray Train's
+FailureConfig. Here a mid-fit rank death costs only the epochs since the
+last checkpoint.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional
+
+from raydp_tpu.cluster.common import ActorDiedError, ClusterError
+
+
+def _invoke(fit_fn, resume_from_epoch, ctx):
+    return fit_fn(ctx, resume_from_epoch)
+
+
+def elastic_fit(
+    fit_fn: Callable[[Any, Optional[int]], Any],
+    world_size: int,
+    checkpoint_dir: str,
+    max_failures: int = 2,
+    job_name: str = "elastic",
+    env: Optional[Dict[str, str]] = None,
+    num_cpus_per_worker: float = 1.0,
+    timeout: float = 300.0,
+    bootstrap: bool = True,
+) -> List[Any]:
+    """Run ``fit_fn(ctx, resume_from_epoch)`` on every rank of an SPMD gang,
+    restarting the WHOLE gang from the latest committed checkpoint when any
+    rank dies mid-fit.
+
+    ``fit_fn`` must write per-epoch checkpoints under ``checkpoint_dir``
+    (JaxEstimator(checkpoint_dir=...) does) and honor the
+    ``resume_from_epoch`` it is passed (None = fresh start). Returns the
+    per-rank results of the first fully-successful attempt.
+    """
+    from raydp_tpu.estimator.jax_estimator import latest_checkpoint_epoch
+    from raydp_tpu.spmd.job import create_spmd_job
+
+    failures = 0
+    while True:
+        resume = latest_checkpoint_epoch(checkpoint_dir)
+        job = create_spmd_job(
+            f"{job_name}-a{failures}",
+            world_size=world_size,
+            env=env,
+            num_cpus_per_worker=num_cpus_per_worker,
+            timeout=timeout,
+        )
+        try:
+            job.start()
+            if bootstrap:
+                job.bootstrap_jax()
+            return job.run(
+                functools.partial(_invoke, fit_fn, resume), timeout=timeout
+            )
+        except (
+            ActorDiedError,
+            ClusterError,
+            ConnectionError,
+            EOFError,
+            TimeoutError,
+        ):
+            failures += 1
+            if failures > max_failures:
+                raise
+            # loop: the next attempt resumes at the newest checkpoint that
+            # landed before the failure
+        finally:
+            job.stop()

@@ -222,20 +222,49 @@ class SpmdJob:
 
     def run(self, fn: Callable[[WorkerContext], Any], timeout: Optional[float] = None) -> List[Any]:
         """Ship ``fn`` to every rank concurrently; gather in rank order
-        (parity: mpi_job.run, :325-339)."""
+        (parity: mpi_job.run, :325-339).
+
+        The gather FAILS FAST: a dead rank surfaces immediately instead of
+        waiting out rank 0 first — with collectives in flight, surviving
+        ranks hang on the dead one, so rank-order result() would stall the
+        whole deadline before reporting the failure. The elastic watchdog
+        depends on this to restart gangs promptly."""
+        import time
+
         with self._lock:
             if not self._started:
                 raise RuntimeError("job not started")
             func_id = self._func_id
             self._func_id += 1
         blob = cloudpickle.dumps(fn)
+        wait = timeout or self.timeout
         futures = [
-            w.run_function.options(timeout=timeout or self.timeout).remote(
-                func_id, blob
-            )
+            w.run_function.options(timeout=wait).remote(func_id, blob)
             for w in self._workers
         ]
-        return [f.result(timeout or self.timeout) for f in futures]
+        results: List[Any] = [None] * len(futures)
+        done = [False] * len(futures)
+        deadline = time.monotonic() + wait
+        while not all(done):
+            for i, future in enumerate(futures):
+                if done[i]:
+                    continue
+                try:
+                    results[i] = future.result(timeout=0.2)
+                    done[i] = True
+                except TimeoutError:
+                    # a consumed future means the REMOTE function raised
+                    # TimeoutError — that's a rank failure, not our probe
+                    if getattr(future, "_done", False):
+                        raise
+                    # otherwise: still running; check the other ranks
+                # ConnectionError / ActorDiedError propagate immediately
+            if not all(done) and time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"spmd job {self.job_name}: "
+                    f"{done.count(False)} rank(s) did not finish within {wait}s"
+                )
+        return results
 
     def stop(self) -> None:
         import time

@@ -187,9 +187,13 @@ def test_oversubscription_rejected(runtime):
 
 
 def test_placement_group_strategies(runtime):
-    # single-node session: STRICT_SPREAD with 2 bundles must fail...
+    # STRICT_SPREAD with more bundles than alive nodes must fail (node count
+    # is dynamic: other test modules may have registered agent nodes)
+    n_nodes = len([n for n in cluster.nodes() if n.alive])
     with pytest.raises(ClusterError, match="STRICT_SPREAD"):
-        cluster.create_placement_group([{"CPU": 1}, {"CPU": 1}], "STRICT_SPREAD")
+        cluster.create_placement_group(
+            [{"CPU": 1}] * (n_nodes + 1), "STRICT_SPREAD"
+        )
     # ...but PACK/STRICT_PACK fit, actors land in bundles, removal frees resources
     pg = cluster.create_placement_group([{"CPU": 1}, {"CPU": 1}], "STRICT_PACK")
     table = cluster.placement_group_table()
