@@ -28,8 +28,12 @@ _LAZY = {
     "from_etl_recoverable": ("raydp_tpu.exchange.dataset", "from_etl_recoverable"),
     "Dataset": ("raydp_tpu.exchange.dataset", "Dataset"),
     "create_spmd_job": ("raydp_tpu.spmd.job", "create_spmd_job"),
+    "elastic_fit": ("raydp_tpu.spmd.elastic", "elastic_fit"),
     "MLDataset": ("raydp_tpu.exchange.ml_dataset", "MLDataset"),
     "JaxEstimator": ("raydp_tpu.estimator.jax_estimator", "JaxEstimator"),
+    # client mode: attach a second driver to a running cluster (the
+    # reference's ray://host:port analog)
+    "connect_cluster": ("raydp_tpu.cluster.api", "connect_cluster"),
 }
 
 

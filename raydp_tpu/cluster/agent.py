@@ -183,7 +183,12 @@ class NodeAgent:
                     continue  # keep the entry: retried next loop — a death
                     # report must not be lost to a transient head blip
                 with self.lock:
-                    self.children.pop(actor_id, None)
+                    # the head may have ALREADY respawned this actor while we
+                    # were reporting (its spawn RPC lands on the server
+                    # thread): only remove the entry we actually reported
+                    current = self.children.get(actor_id)
+                    if current is not None and current.incarnation == incarnation:
+                        del self.children[actor_id]
             now = time.monotonic()
             if now - last_ping >= 2.0:
                 last_ping = now

@@ -24,6 +24,7 @@ import cloudpickle
 
 from raydp_tpu.cluster.common import (
     DRIVER_OWNER,
+    HEAD_ADDR_ENV,
     HEAD_TCP_FILE,
     SESSION_ENV,
     ActorDiedError,
@@ -44,6 +45,13 @@ from raydp_tpu.cluster.common import (
 _lock = threading.RLock()
 _session_dir: Optional[str] = None
 _head_proc: Optional[subprocess.Popen] = None
+_is_client = False  # attached to someone else's cluster: detach, never tear down
+_is_tcp_client = False  # attached over tcp://: cannot host object-store blocks
+_client_env_keys: List[str] = []  # env vars connect_cluster set (cleared on detach)
+
+
+def is_tcp_client() -> bool:
+    return _is_tcp_client
 
 
 def is_initialized() -> bool:
@@ -116,10 +124,84 @@ def init(
         return _session_dir
 
 
+def connect_cluster(address: str, token: Optional[str] = None) -> str:
+    """Attach this process as a DRIVER to an already-running cluster — the
+    analog of the reference's ``ray://host:port`` client mode (its test
+    matrix runs everything twice, in-process and via the client;
+    reference conftest.py:45-52).
+
+    ``address`` is either the cluster's session dir (same host: adopts the
+    Unix socket and token file) or the head's ``tcp://host:port`` (any
+    machine that can reach it; requires the cluster ``token`` hex string —
+    obtain both from the owning driver via ``head_tcp_addr()`` and
+    ``cluster_token()``). A TCP client gets its own shm namespace so object
+    reads always take the network pull path. Clients never tear the cluster
+    down: ``shutdown()`` just detaches."""
+    global _session_dir, _is_client, _is_tcp_client
+    from raydp_tpu.cluster.common import SHM_NS_ENV, TOKEN_ENV, load_token
+
+    with _lock:
+        if _session_dir is not None or _join_from_env() is not None:
+            raise ClusterError("cluster runtime already initialized in this process")
+        set_env: Dict[str, str] = {}
+        if address.startswith("tcp://"):
+            if token is None:
+                raise ClusterError(
+                    "tcp:// attach requires the cluster token "
+                    "(cluster_token() on the owning driver)"
+                )
+            root = os.path.join(tempfile.gettempdir(), "raydp_tpu")
+            os.makedirs(root, exist_ok=True)
+            local_dir = tempfile.mkdtemp(prefix="client-", dir=root)
+            set_env[HEAD_ADDR_ENV] = address
+            set_env[TOKEN_ENV] = token
+            if SHM_NS_ENV not in os.environ:
+                # never map foreign shm directly: this process may be on
+                # another machine — all reads go through block servers
+                set_env[SHM_NS_ENV] = f"client-{uuid.uuid4().hex[:6]}"
+        else:
+            if not os.path.exists(head_sock_path(address)):
+                raise ClusterError(f"no running cluster at {address!r}")
+            local_dir = address
+            set_env[TOKEN_ENV] = load_token(address).hex()
+        os.environ.update(set_env)
+        _session_dir = local_dir
+        try:
+            head_rpc("ping", timeout=10)  # validate before committing
+        except BaseException:
+            # roll back: a typo'd address must not poison the process
+            _session_dir = None
+            for key in set_env:
+                os.environ.pop(key, None)
+            raise
+        _is_client = True
+        _is_tcp_client = address.startswith("tcp://")
+        _client_env_keys.extend(set_env)
+        return _session_dir
+
+
+def cluster_token() -> str:
+    """This cluster's auth token (hex) — hand it to tcp:// clients."""
+    from raydp_tpu.cluster.common import load_token
+
+    return load_token(session_dir()).hex()
+
+
 def shutdown() -> None:
-    global _session_dir, _head_proc
+    global _session_dir, _head_proc, _is_client
     with _lock:
         if _session_dir is None:
+            return
+        if _is_client:  # clients detach; the cluster belongs to its driver
+            global _is_tcp_client
+            _session_dir = None
+            _is_client = False
+            _is_tcp_client = False
+            for key in _client_env_keys:
+                # a later init() in this process must not route to the old
+                # cluster through a stale HEAD_ADDR/TOKEN
+                os.environ.pop(key, None)
+            _client_env_keys.clear()
             return
         if os.environ.get(SESSION_ENV):  # actors never tear the session down
             _session_dir = None

@@ -19,7 +19,6 @@ import signal
 import socket
 import socketserver
 import subprocess
-import sys
 import threading
 import time
 import traceback
@@ -97,12 +96,16 @@ class _ObjectMeta:
     managed by raydp_tpu.store). Parity target: Ray ownership + the reference's
     ownership-transfer path (ObjectStoreWriter.scala:64-85, dataset.py:135-171)."""
 
-    def __init__(self, object_id: str, owner: str, shm_name: str, size: int, node_id: str):
+    def __init__(
+        self, object_id: str, owner: str, shm_name: str, size: int,
+        node_id: str, shm_ns: str = "",
+    ):
         self.object_id = object_id
         self.owner = owner
         self.shm_name = shm_name
         self.size = size
         self.node_id = node_id
+        self.shm_ns = shm_ns
         self.owner_died = False
 
 
@@ -598,10 +601,13 @@ class Head:
     # ---------- object ownership table ----------
 
     def handle_object_put(
-        self, object_id: str, owner: str, shm_name: str, size: int, node_id: str
+        self, object_id: str, owner: str, shm_name: str, size: int,
+        node_id: str, shm_ns: str = "",
     ):
         with self.lock:
-            self.objects[object_id] = _ObjectMeta(object_id, owner, shm_name, size, node_id)
+            self.objects[object_id] = _ObjectMeta(
+                object_id, owner, shm_name, size, node_id, shm_ns
+            )
             return True
 
     def handle_object_lookup(self, object_id: str):
@@ -617,17 +623,19 @@ class Head:
             node = self.nodes.get(meta.node_id)
             # where a non-local reader can pull the bytes: the owning node's
             # agent, or the head itself for head-node objects (parity:
-            # plasma locality + RayDatasetRDD owner addresses, SURVEY §2.2 S8)
+            # plasma locality + RayDatasetRDD owner addresses, SURVEY §2.2 S8).
+            # The WRITER-recorded namespace is authoritative — a tcp client's
+            # blocks carry its namespace even though its "node" is the driver.
             if node is not None and node.agent_addr is not None:
-                shm_ns, fetch_addr = node.shm_ns, node.agent_addr
+                fetch_addr = node.agent_addr
             else:
-                shm_ns, fetch_addr = "", self.tcp_addr
+                fetch_addr = self.tcp_addr
             return {
                 "shm_name": meta.shm_name,
                 "size": meta.size,
                 "owner": meta.owner,
                 "node_id": meta.node_id,
-                "shm_ns": shm_ns,
+                "shm_ns": meta.shm_ns,
                 "fetch_addr": fetch_addr,
             }
 
@@ -797,7 +805,10 @@ class Head:
             for t in threads:
                 t.start()
             for t in threads:
-                t.join(timeout=5)
+                # full join: probes are bounded by their own rpc timeouts; a
+                # timed-out join would leave a straggler mutating `results`
+                # mid-iteration and crash this watchdog permanently
+                t.join()
             for node_id, ok in results.items():
                 if ok:
                     agent_last_ok[node_id] = now

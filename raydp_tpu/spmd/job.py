@@ -242,22 +242,36 @@ class SpmdJob:
             w.run_function.options(timeout=wait).remote(func_id, blob)
             for w in self._workers
         ]
+        import select
+
         results: List[Any] = [None] * len(futures)
         done = [False] * len(futures)
+        for i, future in enumerate(futures):
+            if getattr(future, "_sock", None) is None:  # already-completed
+                results[i] = future.result()
+                done[i] = True
         deadline = time.monotonic() + wait
         while not all(done):
-            for i, future in enumerate(futures):
-                if done[i]:
+            # ONE select over every pending rank's socket: sweep latency is
+            # constant, not world_size × probe (a dead rank must surface
+            # immediately — the elastic watchdog depends on it)
+            pending = [
+                (i, f) for i, f in enumerate(futures)
+                if not done[i] and getattr(f, "_sock", None) is not None
+            ]
+            readable, _, _ = select.select([f._sock for _, f in pending], [], [], 0.2)
+            ready = {id(sock) for sock in readable}
+            for i, future in pending:
+                if id(future._sock) not in ready:
                     continue
                 try:
-                    results[i] = future.result(timeout=0.2)
+                    results[i] = future.result(timeout=0.05)
                     done[i] = True
                 except TimeoutError:
                     # a consumed future means the REMOTE function raised
                     # TimeoutError — that's a rank failure, not our probe
                     if getattr(future, "_done", False):
                         raise
-                    # otherwise: still running; check the other ranks
                 # ConnectionError / ActorDiedError propagate immediately
             if not all(done) and time.monotonic() > deadline:
                 raise TimeoutError(

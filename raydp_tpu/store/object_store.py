@@ -212,6 +212,14 @@ class WritableBlock:
 def _register(ref: ObjectRef, owner: Optional[str]) -> None:
     from raydp_tpu.cluster.worker import current_context
 
+    if cluster_api.is_tcp_client():
+        raise ClusterError(
+            "tcp:// client processes cannot host object-store blocks (no "
+            "block server runs on a client machine, so nothing could ever "
+            "serve them); create data through the cluster — e.g. "
+            "session.read_parquet / executor-side tasks — or attach by "
+            "session dir on the head host"
+        )
     ctx = current_context()
     cluster_api.head_rpc(
         "object_put",
@@ -220,6 +228,7 @@ def _register(ref: ObjectRef, owner: Optional[str]) -> None:
         shm_name=ref.shm_name,
         size=ref.size,
         node_id=ctx.node_id if ctx else "driver",
+        shm_ns=shm_namespace(),
     )
 
 

@@ -1,0 +1,157 @@
+"""Client-mode attach: a second driver joins an already-running cluster.
+
+Parity: the reference runs its whole matrix twice — in-process Ray AND
+``ray://localhost:10001`` client mode (reference conftest.py:45-52) — plus a
+driver-inside-a-Ray-actor test (test_spark_cluster.py:62-81) and two drivers
+sharing one cluster (test_init_spark_twice, :220-249).
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from raydp_tpu.cluster import api as cluster
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def running_cluster():
+    cluster.init(num_cpus=6, memory=4 << 30)
+    yield {
+        "session_dir": cluster.session_dir(),
+        "tcp": cluster.head_tcp_addr(),
+        "token": cluster.cluster_token(),
+    }
+
+
+def _run_client(code: str, timeout: int = 180) -> str:
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join([ROOT] + sys.path)
+    # a clean driver process: no inherited session/token/head vars
+    for var in (
+        "RAYDP_TPU_SESSION", "RAYDP_TPU_HEAD_ADDR", "RAYDP_TPU_TOKEN",
+        "RAYDP_TPU_SHM_NS",
+    ):
+        env.pop(var, None)
+    out = subprocess.run(
+        [sys.executable, "-c", textwrap.dedent(code)],
+        capture_output=True, text=True, timeout=timeout, env=env,
+    )
+    assert out.returncode == 0, f"client failed:\n{out.stdout}\n{out.stderr}"
+    return out.stdout
+
+
+def test_second_driver_attaches_by_session_dir(running_cluster):
+    """A separate driver process adopts the session dir, runs its OWN ETL
+    session on the shared cluster, and detaching leaves the cluster alive
+    (two-drivers-one-cluster parity)."""
+    out = _run_client(f"""
+        from raydp_tpu.cluster import api as cluster
+        import raydp_tpu
+        import numpy as np, pandas as pd
+
+        cluster.connect_cluster({running_cluster['session_dir']!r})
+        s = raydp_tpu.init_etl('client-a', num_executors=1, executor_cores=1,
+                               executor_memory='200M')
+        pdf = pd.DataFrame({{'k': np.arange(100) % 5, 'v': np.arange(100)}})
+        df = s.from_pandas(pdf, num_partitions=2)
+        total = df.group_by('k').sum('v').to_pandas()['sum(v)'].sum()
+        print('TOTAL', int(total))
+        raydp_tpu.stop_etl()
+        cluster.shutdown()  # client detach: must NOT kill the cluster
+    """)
+    assert "TOTAL 4950" in out
+    # the cluster survived the client's shutdown()
+    assert cluster.head_rpc("ping") == "pong"
+
+
+def test_tcp_client_attaches_with_token(running_cluster):
+    """tcp:// attach with the cluster token: the client spawns an actor and
+    round-trips data through the object store (its reads take the network
+    pull path — the client has its own shm namespace)."""
+    out = _run_client(f"""
+        from raydp_tpu.cluster import api as cluster
+        from raydp_tpu.store import object_store as store
+
+        cluster.connect_cluster({running_cluster['tcp']!r},
+                                token={running_cluster['token']!r})
+
+        class KV:
+            def __init__(self):
+                self.data = {{}}
+            def put(self, k, payload):
+                self.data[k] = store.put(payload)
+                return self.data[k]
+            def get_ref(self, k):
+                return self.data[k]
+
+        h = cluster.spawn(KV, name='client-kv', num_cpus=0.5)
+        ref = h.put('a', b'x' * 70000)
+        data = store.get_bytes(ref)
+        print('LEN', len(data), 'FETCHES', store.stats['remote_fetches'])
+
+        # tcp clients cannot HOST blocks (nothing could serve them): loud
+        # error instead of silently-unreadable objects
+        from raydp_tpu.cluster.common import ClusterError
+        try:
+            store.put(b'nope')
+            print('PUT ALLOWED')
+        except ClusterError as e:
+            print('PUT REJECTED', 'block server' in str(e))
+        h.kill()
+        cluster.shutdown()
+    """)
+    assert "PUT REJECTED True" in out
+    assert "LEN 70000" in out
+    # the actor lives on the head node (ns ''), the client in its own ns →
+    # the read went over the network
+    assert "FETCHES 1" in out
+    assert cluster.head_rpc("ping") == "pong"
+
+
+def test_tcp_client_rejected_without_token(running_cluster):
+    out = _run_client(f"""
+        from raydp_tpu.cluster import api as cluster
+        from raydp_tpu.cluster.common import ClusterError
+        try:
+            cluster.connect_cluster({running_cluster['tcp']!r})
+            print('NO ERROR')
+        except ClusterError as e:
+            print('REJECTED', 'token' in str(e))
+    """)
+    assert "REJECTED True" in out
+
+
+def test_driver_inside_an_actor(running_cluster):
+    """An actor can itself act as a driver: spawn sub-actors and run a full
+    ETL query (reference test_spark_remote: the Spark driver runs inside a
+    Ray actor, test_spark_cluster.py:62-81)."""
+
+    class DriverActor:
+        def run_etl(self):
+            import numpy as np
+            import pandas as pd
+
+            import raydp_tpu
+
+            s = raydp_tpu.init_etl(
+                "inner-driver", num_executors=1, executor_cores=1,
+                executor_memory="200M",
+            )
+            pdf = pd.DataFrame({"x": np.arange(50, dtype=np.float64)})
+            df = s.from_pandas(pdf, num_partitions=2)
+            total = float(df.agg({"x": "sum"}).to_pandas().iloc[0, 0])
+            raydp_tpu.stop_etl()
+            return total
+
+    h = cluster.spawn(DriverActor, name="outer-driver", num_cpus=1, light=True)
+    try:
+        assert h.run_etl.options(timeout=120).remote().result() == sum(range(50))
+    finally:
+        h.kill()

@@ -198,8 +198,16 @@ def test_full_etl_session_spans_nodes(two_nodes):
     import raydp_tpu
     from raydp_tpu.etl import functions as F
 
+    # size executors from LIVE free resources so the second one cannot fit
+    # on the head node and must spill to the agent node (other test modules
+    # may have grown the head's CPU pool)
+    avail = cluster.available_resources()
+    head_free = avail[two_nodes["head_node"].node_id].get("CPU", 0.0)
+    agent_free = avail[two_nodes["agent_node"].node_id].get("CPU", 0.0)
+    cores = int(min(agent_free, head_free // 2 + 1))
+    assert cores >= 1, (head_free, agent_free)
     session = raydp_tpu.init_etl(
-        "mh-session", num_executors=2, executor_cores=2,
+        "mh-session", num_executors=2, executor_cores=cores,
         executor_memory="300M",
     )
     try:
