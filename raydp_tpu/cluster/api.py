@@ -48,6 +48,7 @@ _head_proc: Optional[subprocess.Popen] = None
 _is_client = False  # attached to someone else's cluster: detach, never tear down
 _is_tcp_client = False  # attached over tcp://: cannot host object-store blocks
 _client_env_keys: List[str] = []  # env vars connect_cluster set (cleared on detach)
+_client_local_dir: Optional[str] = None  # tcp client's scratch dir (removed on detach)
 
 
 def is_tcp_client() -> bool:
@@ -173,7 +174,13 @@ def connect_cluster(address: str, token: Optional[str] = None) -> str:
             _session_dir = None
             for key in set_env:
                 os.environ.pop(key, None)
+            if address.startswith("tcp://"):
+                import shutil
+
+                shutil.rmtree(local_dir, ignore_errors=True)
             raise
+        global _client_local_dir
+        _client_local_dir = local_dir if address.startswith("tcp://") else None
         _is_client = True
         _is_tcp_client = address.startswith("tcp://")
         _client_env_keys.extend(set_env)
@@ -193,7 +200,7 @@ def shutdown() -> None:
         if _session_dir is None:
             return
         if _is_client:  # clients detach; the cluster belongs to its driver
-            global _is_tcp_client
+            global _is_tcp_client, _client_local_dir
             _session_dir = None
             _is_client = False
             _is_tcp_client = False
@@ -202,6 +209,11 @@ def shutdown() -> None:
                 # cluster through a stale HEAD_ADDR/TOKEN
                 os.environ.pop(key, None)
             _client_env_keys.clear()
+            if _client_local_dir is not None:
+                import shutil
+
+                shutil.rmtree(_client_local_dir, ignore_errors=True)
+                _client_local_dir = None
             return
         if os.environ.get(SESSION_ENV):  # actors never tear the session down
             _session_dir = None

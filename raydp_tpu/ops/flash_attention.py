@@ -351,8 +351,27 @@ def _bwd_dkv_kernel(
 def _flash_backward(
     q, k, v, o, lse, g, causal, block_q, block_k, interpret
 ):
-    """Blockwise dq/dk/dv. lse: [B,H,T] logsumexp of the scaled scores;
-    o: normalized forward output; g: cotangent of o."""
+    """Blockwise dq/dk/dv for the single-device surface (offsets 0).
+    lse: [B,H,T] logsumexp of the scaled scores; o: normalized forward
+    output; g: cotangent of o."""
+    dsum = jnp.sum(
+        g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1
+    )
+    return flash_backward_blocks(
+        q, k, v, lse, dsum, g, 0, 0, causal, block_q, block_k, interpret
+    )
+
+
+def flash_backward_blocks(
+    q, k, v, lse, dsum, g, q_offset, k_offset, causal: bool = False,
+    block_q: int = 128, block_k: int = 128, interpret: bool | None = None,
+):
+    """One blockwise-backward pass: (dq, dk, dv) partials of q [B,H,Tq,D]
+    against k/v [B,H,Tk,D], given the GLOBAL per-row logsumexp ``lse`` and
+    ``dsum = rowsum(do·o)`` [B,H,Tq] and the blocks' global positions for
+    causal masking — the per-ring-step counterpart of
+    ``flash_attention_stats``: `parallel.ring_attention` sums these partials
+    as K/V (and their gradient accumulators) rotate around the ring."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
@@ -370,11 +389,7 @@ def _flash_backward(
     vf = v.reshape(bh, tk, d)
     dof = g.reshape(bh, t, d)
     lsef = lse.reshape(bh, t, 1)
-    # dsum_i = rowsum(do_i * o_i): tiny elementwise pass outside the kernels
-    dsumf = jnp.sum(
-        dof.astype(jnp.float32) * o.reshape(bh, t, d).astype(jnp.float32),
-        axis=-1, keepdims=True,
-    )
+    dsumf = dsum.astype(jnp.float32).reshape(bh, t, 1)
 
     union = _union_vma(qf, kf, vf, dof)
 
@@ -383,8 +398,8 @@ def _flash_backward(
             return jax.ShapeDtypeStruct(shape, dtype, vma=union)
         return jax.ShapeDtypeStruct(shape, dtype)
 
-    q_off = jnp.asarray([0], jnp.int32)
-    k_off = jnp.asarray([0], jnp.int32)
+    q_off = jnp.asarray([q_offset], jnp.int32).reshape(1)
+    k_off = jnp.asarray([k_offset], jnp.int32).reshape(1)
     if union is not None:
         for axis in union:
             q_off = _pvary_scalar(q_off, axis)

@@ -18,6 +18,7 @@ are masked to -inf so the softmax ignores them).
 
 from __future__ import annotations
 
+import functools
 from functools import partial
 from typing import Optional
 
@@ -58,24 +59,8 @@ def _merge(o1, m1, l1, o2, m2, l2):
     return o, m, l
 
 
-def ring_attention(
-    q: jnp.ndarray,
-    k: jnp.ndarray,
-    v: jnp.ndarray,
-    axis_name: str,
-    causal: bool = False,
-    use_flash: bool = False,
-) -> jnp.ndarray:
-    """Exact attention with sequence sharded over ``axis_name``.
-
-    Call inside ``shard_map`` (or any SPMD context where ``axis_name`` is
-    bound). Shapes are per-device: q, k, v: [B, H, T_local, D]; the global
-    sequence is ``T_local * axis_size`` in ring order.
-
-    ``use_flash=True`` computes each (Q-block, K/V-block) product with the
-    fused pallas flash kernel (O(T_local) VMEM, MXU scores) instead of the
-    einsum path; the cross-device merge is identical.
-    """
+def _ring_forward_stats(q, k, v, axis_name, causal, use_flash):
+    """Ring forward returning (o_unnormalized, m, l)."""
     n = lax.axis_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, t, d = q.shape
@@ -119,8 +104,112 @@ def ring_attention(
         (o, m, l, _, _), _ = lax.scan(  # noqa: E741
             step, (o, m, l, k, v), jnp.arange(1, n)
         )
-    # keep the caller's dtype (flash block products accumulate in f32)
+    return o, m, l
+
+
+def _block_grads(q, k, v, lse, dsum, g, q_off, k_off, causal, use_flash):
+    """(dq, dk, dv) partials of the local Q block against ONE K/V block,
+    from the GLOBAL logsumexp/dsum — the backward counterpart of the
+    forward's block products."""
+    if use_flash:
+        from raydp_tpu.ops.flash_attention import flash_backward_blocks
+
+        return flash_backward_blocks(
+            q, k, v, lse, dsum, g, q_off, k_off, causal
+        )
+    scale = q.shape[-1] ** -0.5
+    t, tk = q.shape[2], k.shape[2]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k).astype(jnp.float32) * scale
+    if causal:
+        gq = q_off + jnp.arange(t)
+        gk = k_off + jnp.arange(tk)
+        s = jnp.where(gq[:, None] >= gk[None, :], s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])  # masked rows underflow to exactly 0
+    gf = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, gf)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", gf, v.astype(jnp.float32))
+    ds = p * (dp - dsum[..., None]) * scale
+    dq = jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return dq, dk, dv
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    causal: bool = False,
+    use_flash: bool = False,
+) -> jnp.ndarray:
+    """Exact attention with sequence sharded over ``axis_name``.
+
+    Call inside ``shard_map`` (or any SPMD context where ``axis_name`` is
+    bound). Shapes are per-device: q, k, v: [B, H, T_local, D]; the global
+    sequence is ``T_local * axis_size`` in ring order.
+
+    ``use_flash=True`` computes each (Q-block, K/V-block) product with the
+    fused pallas flash kernel (O(T_local) VMEM, MXU scores) instead of the
+    einsum path; the cross-device merge is identical.
+
+    TRAINING is O(T_local) memory either way: the custom VJP runs a second
+    ring pass — dk/dv accumulators rotate WITH their K/V blocks and arrive
+    home after a full cycle — rebuilding each block's probabilities from the
+    saved global logsumexp instead of saving any [T, T] intermediate.
+    """
+    o, m, l = _ring_forward_stats(q, k, v, axis_name, causal, use_flash)  # noqa: E741
     return (o / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
+
+
+def _ring_fwd(q, k, v, axis_name, causal, use_flash):
+    o, m, l = _ring_forward_stats(q, k, v, axis_name, causal, use_flash)  # noqa: E741
+    out = (o / jnp.maximum(l[..., None], 1e-30)).astype(q.dtype)
+    lse = m + jnp.log(jnp.maximum(l, 1e-30))  # [B,H,T] global logsumexp
+    return out, (q, k, v, out, lse)
+
+
+def _ring_bwd(axis_name, causal, use_flash, residuals, g):
+    q, k, v, out, lse = residuals
+    n = lax.axis_size(axis_name)
+    my_idx = lax.axis_index(axis_name)
+    t, tk = q.shape[2], k.shape[2]
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    dsum = jnp.sum(
+        g.astype(jnp.float32) * out.astype(jnp.float32), axis=-1
+    )  # [B,H,T]
+
+    def step(carry, s):
+        dq, k_cur, v_cur, dk_cur, dv_cur = carry
+        src = (my_idx - s) % n  # origin of the block currently held
+        dq_p, dk_p, dv_p = _block_grads(
+            q, k_cur, v_cur, lse, dsum, g,
+            my_idx * t, src * tk, causal, use_flash,
+        )
+        dq = dq + dq_p
+        dk_cur = dk_cur + dk_p
+        dv_cur = dv_cur + dv_p
+        # rotate the block AND its gradient accumulators together: after a
+        # full cycle every (k, v, dk, dv) quadruple is back on its home
+        # device with contributions from every Q shard accumulated
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+        return (dq, k_cur, v_cur, dk_cur, dv_cur), None
+
+    # derive zeros from the inputs so they carry the same varying-axes type
+    # under shard_map (a fresh constant is unvaried; the loop body's outputs
+    # are varying, and scan requires carry types to match exactly)
+    zeros_q = (q * 0).astype(jnp.float32)
+    zeros_k = (k * 0).astype(jnp.float32)
+    zeros_v = (v * 0).astype(jnp.float32)
+    init = (zeros_q, k, v, zeros_k, zeros_v)
+    (dq, _, _, dk, dv), _ = lax.scan(step, init, jnp.arange(n))
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+ring_attention.defvjp(_ring_fwd, _ring_bwd)
 
 
 def ring_attention_sharded(

@@ -242,7 +242,7 @@ class SpmdJob:
             w.run_function.options(timeout=wait).remote(func_id, blob)
             for w in self._workers
         ]
-        import select
+        import selectors
 
         results: List[Any] = [None] * len(futures)
         done = [False] * len(futures)
@@ -252,17 +252,21 @@ class SpmdJob:
                 done[i] = True
         deadline = time.monotonic() + wait
         while not all(done):
-            # ONE select over every pending rank's socket: sweep latency is
+            # ONE poll over every pending rank's socket: sweep latency is
             # constant, not world_size × probe (a dead rank must surface
-            # immediately — the elastic watchdog depends on it)
+            # immediately — the elastic watchdog depends on it). selectors
+            # (epoll) rather than select(): a long-lived driver can hold
+            # fds >= FD_SETSIZE, which select() rejects outright.
             pending = [
                 (i, f) for i, f in enumerate(futures)
                 if not done[i] and getattr(f, "_sock", None) is not None
             ]
-            readable, _, _ = select.select([f._sock for _, f in pending], [], [], 0.2)
-            ready = {id(sock) for sock in readable}
+            with selectors.DefaultSelector() as sel:
+                for i, f in pending:
+                    sel.register(f._sock, selectors.EVENT_READ, i)
+                ready = {key.data for key, _ in sel.select(timeout=0.2)}
             for i, future in pending:
-                if id(future._sock) not in ready:
+                if i not in ready:
                     continue
                 try:
                     results[i] = future.result(timeout=0.05)

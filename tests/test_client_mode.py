@@ -28,6 +28,7 @@ def running_cluster():
         "tcp": cluster.head_tcp_addr(),
         "token": cluster.cluster_token(),
     }
+    cluster.shutdown()  # don't leak this pool into later test modules
 
 
 def _run_client(code: str, timeout: int = 180) -> str:
