@@ -113,9 +113,19 @@ class NodeAgent:
             self.stats["spawned"] += 1
         return True
 
-    def handle_kill_actor(self, actor_id: str):
+    def handle_kill_actor(self, actor_id: str, incarnation: int = -1):
+        """Kill a locally-hosted worker. ``incarnation`` >= 0 restricts the
+        kill to that exact spawn — the head's fence-out of a possibly-
+        delivered stale spawn must not hit a newer healthy replacement that
+        was respawned onto this agent in the meantime."""
         with self.lock:
             child = self.children.get(actor_id)
+            if (
+                child is not None
+                and incarnation >= 0
+                and child.incarnation != incarnation
+            ):
+                return False  # a different (newer) spawn owns the id now
         if child is not None and child.proc.poll() is None:
             try:
                 os.killpg(child.proc.pid, signal.SIGKILL)

@@ -103,15 +103,24 @@ def load_token(session_dir: str) -> bytes:
 
 
 def verify_token(sock: socket.socket, expected: bytes) -> bool:
-    """Server side of the TCP handshake: read and compare the secret before
-    any frame touches cloudpickle."""
+    """Server side of the TCP handshake: challenge-response, verified before
+    any frame touches cloudpickle (a reachable port must not mean arbitrary
+    unpickling). The server sends a fresh nonce and the client proves
+    possession with HMAC-SHA256(token, nonce) — the secret itself never
+    crosses the wire, so a passive observer cannot capture-and-replay it.
+    (An attacker who can fully MITM an established connection can still relay
+    frames; untrusted networks need TLS on top.)"""
+    import hashlib
     import hmac
 
     try:
-        presented = _recv_exact(sock, TOKEN_LEN)
+        nonce = os.urandom(TOKEN_LEN)
+        sock.sendall(nonce)
+        presented = _recv_exact(sock, hashlib.sha256().digest_size)
     except (ConnectionError, OSError):
         return False
-    return hmac.compare_digest(presented, expected)
+    digest = hmac.new(expected, nonce, hashlib.sha256).digest()
+    return hmac.compare_digest(presented, digest)
 
 
 def connect(addr: str, timeout: Optional[float] = None) -> socket.socket:
@@ -126,7 +135,12 @@ def connect(addr: str, timeout: Optional[float] = None) -> socket.socket:
         sock.settimeout(timeout)
         sock.connect((host, int(port)))
         sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-        sock.sendall(session_token())
+        # client side of the challenge-response handshake (see verify_token)
+        import hashlib
+        import hmac
+
+        nonce = _recv_exact(sock, TOKEN_LEN)
+        sock.sendall(hmac.new(session_token(), nonce, hashlib.sha256).digest())
         return sock
     sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     sock.settimeout(timeout)

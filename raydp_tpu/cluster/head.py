@@ -397,6 +397,7 @@ class Head:
                         timeout=15,
                     )
                 except Exception:
+                    fenced = False
                     with self.lock:
                         if actor.incarnation == incarnation and actor.state not in (
                             ActorState.DEAD,
@@ -404,7 +405,35 @@ class Head:
                             # credit back what _schedule charged: the retry
                             # path re-schedules (and re-charges) from scratch
                             self._release_actor_resources(actor)
+                            # The RPC may have been DELIVERED despite the
+                            # timeout: a twin worker could be coming up on the
+                            # agent. Fence it out by bumping the incarnation
+                            # before the retry respawns — handle_actor_ready /
+                            # handle_actor_exited guards then reject the stale
+                            # twin, which cannot route calls or recycle the
+                            # replacement.
+                            actor.incarnation += 1
                             actor.pending_respawn = True
+                            fenced = True
+                    # Best-effort reap of the possible twin (outside the
+                    # lock), keyed by the STALE incarnation: the monitor may
+                    # respawn onto this same agent before the kill lands, and
+                    # an id-only kill would hit the healthy replacement.
+                    if fenced:
+                        try:
+                            rpc(
+                                agent_addr,
+                                (
+                                    "kill_actor",
+                                    {
+                                        "actor_id": spec.actor_id,
+                                        "incarnation": incarnation,
+                                    },
+                                ),
+                                timeout=3,
+                            )
+                        except Exception:
+                            pass
 
             threading.Thread(target=_remote_spawn, daemon=True).start()
             actor.proc = None

@@ -156,6 +156,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         resume_from_epoch: Optional[int] = None,
         streaming: bool = False,
         sync_every_steps: int = 32,
+        scan_epochs: Optional[bool] = None,
+        scan_memory_limit: int = 1 << 30,
     ):
         self._model_arg = model
         self._optimizer_arg = optimizer
@@ -186,6 +188,14 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         # on local hardware the periodic drain costs one pipeline bubble
         # per N steps (<1%). 0 disables.
         self.sync_every_steps = sync_every_steps
+        # scan_epochs: drive a whole epoch with ONE jitted lax.scan instead
+        # of a Python dispatch per step — removes the per-step framework
+        # overhead entirely (the 13-16% train-only gap vs a raw jit loop).
+        # None = auto: on when the staged arrays fit scan_memory_limit.
+        # Single-device additionally keeps the dataset resident on device and
+        # gathers shuffled batches there, so H2D happens once per fit.
+        self.scan_epochs = scan_epochs
+        self.scan_memory_limit = scan_memory_limit
 
         self._module = None
         self._params = None
@@ -248,7 +258,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         """Arrow → host numpy exactly once; epochs reshuffle indices only.
         Re-fitting the same Dataset (retries, hyperparameter sweeps, repeated
         benchmarking) reuses the staged arrays — keyed by dataset identity +
-        column selection, invalidated when the block list changes.
+        column selection, invalidated when the block list changes. The cache
+        holds up to 4 dataset-sized host copies for the estimator's lifetime
+        (LRU-evicted); fitting several large datasets through one estimator
+        retains multiples of dataset memory — call ``clear_staging_cache()``
+        to release them.
 
         Multi-process (one process per TPU host): each process stages only its
         equal-share shard — ``device_put_batch`` then assembles the global
@@ -269,7 +283,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         if cache is None:
             cache = self._stage_cache = {}
         if key in cache:
-            return cache[key]
+            # LRU: re-insert on hit so eviction drops the least-recently-used
+            # entry, not the oldest-staged one
+            staged = cache.pop(key)
+            cache[key] = staged
+            return staged
         features, labels = ds.to_numpy(
             self.feature_columns,
             self.label_column,
@@ -423,8 +441,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
         # loss accumulates ON DEVICE: a host float(loss) per step would force
         # a sync and serialize the H2D/compute pipeline (measured 6× slowdown)
-        @partial_jit(donate_argnums=donate)
-        def train_step(params, opt_state, loss_sum, x, y):
+        def step_impl(params, opt_state, loss_sum, x, y):
             def compute(p):
                 return loss_fn(module.apply(p, x), y)
 
@@ -435,6 +452,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 opt_state2,
                 loss_sum + loss,
             )
+
+        train_step = partial_jit(donate_argnums=donate)(step_impl)
 
         eval_step = self._make_eval_step(module, loss_fn)
 
@@ -471,38 +490,46 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         self.compile_seconds_ = init_compile
         first_step_done = False
         with profile_ctx, mesh:
+            run_scan_epoch = self._build_scan_runner(
+                train_source, batch_size, mesh, step_impl, donate
+            )
             for epoch in range(start_epoch, self.num_epochs):
                 epoch_start = time.perf_counter()
                 epoch_seed = None if not self.shuffle else self.seed + epoch
-                train_iter = PrefetchingDeviceIterator(
-                    self._epoch_batches(train_source, batch_size, epoch_seed),
-                    mesh,
-                )
-                loss_sum = jnp.zeros((), jnp.float32)
-                steps = 0
-                for x, y in train_iter:
-                    if not first_step_done:
-                        # the first call compiles (cold TPU compiles take tens
-                        # of seconds); record it so callers can report
-                        # steady-state throughput separately
-                        t0 = time.perf_counter()
-                        params, opt_state, loss_sum = train_step(
-                            params, opt_state, loss_sum, x, y
-                        )
-                        jax.block_until_ready(loss_sum)
-                        self.compile_seconds_ += time.perf_counter() - t0
-                        first_step_done = True
-                    else:
-                        params, opt_state, loss_sum = train_step(
-                            params, opt_state, loss_sum, x, y
-                        )
-                    steps += 1
-                    if (
-                        self.sync_every_steps
-                        and steps % self.sync_every_steps == 0
-                    ):
-                        # bounded pipeline bubble; see __init__ comment
-                        jax.block_until_ready(loss_sum)
+                if run_scan_epoch is not None:
+                    params, opt_state, loss_sum, steps = run_scan_epoch(
+                        params, opt_state, epoch_seed
+                    )
+                else:
+                    train_iter = PrefetchingDeviceIterator(
+                        self._epoch_batches(train_source, batch_size, epoch_seed),
+                        mesh,
+                    )
+                    loss_sum = jnp.zeros((), jnp.float32)
+                    steps = 0
+                    for x, y in train_iter:
+                        if not first_step_done:
+                            # the first call compiles (cold TPU compiles take
+                            # tens of seconds); record it so callers can
+                            # report steady-state throughput separately
+                            t0 = time.perf_counter()
+                            params, opt_state, loss_sum = train_step(
+                                params, opt_state, loss_sum, x, y
+                            )
+                            jax.block_until_ready(loss_sum)
+                            self.compile_seconds_ += time.perf_counter() - t0
+                            first_step_done = True
+                        else:
+                            params, opt_state, loss_sum = train_step(
+                                params, opt_state, loss_sum, x, y
+                            )
+                        steps += 1
+                        if (
+                            self.sync_every_steps
+                            and steps % self.sync_every_steps == 0
+                        ):
+                            # bounded pipeline bubble; see __init__ comment
+                            jax.block_until_ready(loss_sum)
                 # defer the host read: float(loss_sum) here would sync the
                 # pipeline every epoch; store the device scalar instead
                 record: Dict[str, Any] = {
@@ -531,6 +558,140 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         # checkpointing does its own device_get
         self._params = params
         return self._history
+
+    def _build_scan_runner(self, train_source, batch_size, mesh, step_impl, donate):
+        """Whole-epoch training as ONE jitted ``lax.scan`` over the staged
+        batches — removes the per-step Python dispatch that costs 13-16% vs a
+        raw jit loop (VERDICT r2 item 2). Two variants:
+
+        - single-device: the dataset lives ON DEVICE for the whole fit; each
+          epoch ships only a permutation vector and gathers shuffled batches
+          device-side (H2D of the data happens once per fit — decisive on
+          tunneled PJRT transports where transfers are slow);
+        - multi-device / multi-process: host-shuffles, reshapes to
+          [steps, batch, F] and uploads once per epoch (same H2D volume as the
+          per-step path, but a single dispatch), sharded P(None, "data", ...).
+
+        Compilation is AOT (``lower().compile()``) so ``compile_seconds_``
+        records the real compile cost rather than folding a whole epoch's
+        compute into it. Returns None when the scan path doesn't apply
+        (streaming, oversized staged arrays, or scan_epochs=False)."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from raydp_tpu.exchange.jax_io import _mesh_device_count
+
+        if self.streaming or not isinstance(train_source, _HostArrays):
+            return None
+        if self.scan_epochs is False:
+            return None
+        feats, labs = train_source.features, train_source.labels
+        if labs is None or len(feats) < batch_size:
+            return None
+        if self.scan_epochs is None:
+            if feats.nbytes + labs.nbytes > self.scan_memory_limit:
+                return None
+
+        n = len(feats)
+        steps_per_epoch = n // batch_size
+        n_used = steps_per_epoch * batch_size
+        feat_dim = feats.shape[1]
+        device_resident = (
+            jax.process_count() == 1 and _mesh_device_count(mesh) == 1
+        )
+
+        def epoch_body(params, opt_state, xb, yb):
+            def body(carry, xy):
+                p, o, ls = carry
+                p, o, ls = step_impl(p, o, ls, xy[0], xy[1])
+                return (p, o, ls), None
+
+            (params, opt_state, loss_sum), _ = lax.scan(
+                body, (params, opt_state, jnp.zeros((), jnp.float32)), (xb, yb)
+            )
+            return params, opt_state, loss_sum
+
+        state: Dict[str, Any] = {"compiled": None}
+
+        def _order(seed):
+            order = np.arange(n)
+            if self.shuffle:
+                np.random.default_rng(seed).shuffle(order)
+            return order[:n_used].astype(np.int32)
+
+        if device_resident:
+            from raydp_tpu.exchange.jax_io import _mesh_single_device
+
+            device = _mesh_single_device(mesh)
+            if device != jax.devices()[0]:
+                xs_dev = jax.device_put(feats, device)
+                ys_dev = jax.device_put(labs, device)
+            else:
+                # default device: stay uncommitted (committed arrays force a
+                # slow executor path on some PJRT plugins — see device_put_batch)
+                xs_dev = jnp.asarray(feats)
+                ys_dev = jnp.asarray(labs)
+
+            def epoch_gather(params, opt_state, xs, ys, perm):
+                xb = xs[perm].reshape(steps_per_epoch, batch_size, feat_dim)
+                yb = ys[perm].reshape(
+                    (steps_per_epoch, batch_size) + ys.shape[1:]
+                )
+                return epoch_body(params, opt_state, xb, yb)
+
+            jitted = jax.jit(
+                epoch_gather, donate_argnums=(0, 1) if donate else ()
+            )
+
+            def run_epoch(params, opt_state, seed):
+                perm = jnp.asarray(_order(seed))
+                if state["compiled"] is None:
+                    t0 = time.perf_counter()
+                    state["compiled"] = jitted.lower(
+                        params, opt_state, xs_dev, ys_dev, perm
+                    ).compile()
+                    self.compile_seconds_ += time.perf_counter() - t0
+                params, opt_state, loss_sum = state["compiled"](
+                    params, opt_state, xs_dev, ys_dev, perm
+                )
+                return params, opt_state, loss_sum, steps_per_epoch
+
+            return run_epoch
+
+        x_sharding = NamedSharding(mesh, PartitionSpec(None, "data", None))
+        y_sharding = NamedSharding(
+            mesh, PartitionSpec(None, "data", *([None] * (labs.ndim - 1)))
+        )
+        jitted = jax.jit(epoch_body, donate_argnums=(0, 1) if donate else ())
+
+        def _stage_epoch(seed):
+            perm = _order(seed)
+            xb = feats[perm].reshape(steps_per_epoch, batch_size, feat_dim)
+            yb = labs[perm].reshape((steps_per_epoch, batch_size) + labs.shape[1:])
+            if jax.process_count() > 1:
+                return (
+                    jax.make_array_from_process_local_data(x_sharding, xb),
+                    jax.make_array_from_process_local_data(y_sharding, yb),
+                )
+            return (
+                jax.device_put(xb, x_sharding),
+                jax.device_put(yb, y_sharding),
+            )
+
+        def run_epoch(params, opt_state, seed):
+            xb, yb = _stage_epoch(seed)
+            if state["compiled"] is None:
+                t0 = time.perf_counter()
+                state["compiled"] = jitted.lower(params, opt_state, xb, yb).compile()
+                self.compile_seconds_ += time.perf_counter() - t0
+            params, opt_state, loss_sum = state["compiled"](
+                params, opt_state, xb, yb
+            )
+            return params, opt_state, loss_sum, steps_per_epoch
+
+        return run_epoch
 
     def _epoch_batches(self, source, batch_size, seed, shuffle=None):
         """One epoch of host batches from either a staged ``_HostArrays`` or

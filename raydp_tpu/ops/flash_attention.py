@@ -381,6 +381,10 @@ def flash_backward_blocks(
     tk = k.shape[2]
     block_q = min(block_q, t)
     block_k = min(block_k, tk)
+    if t % block_q or tk % block_k:
+        raise ValueError(
+            f"sequence lengths ({t}, {tk}) must divide blocks ({block_q}, {block_k})"
+        )
     bh = b * h
     scale = d**-0.5
 

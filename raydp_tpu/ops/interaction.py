@@ -17,6 +17,7 @@ Two paths:
 from __future__ import annotations
 
 from functools import partial
+from typing import Sequence
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +74,66 @@ def _interaction_bwd(block_batch, interpret, stacked, g):
 
 
 dot_interaction_pallas.defvjp(_interaction_fwd, _interaction_bwd)
+
+
+def _active_mesh():
+    """The mesh governing the current trace: the new-style context
+    (``jax.set_mesh`` / ``use_abstract_mesh``) or the legacy ``with mesh:``
+    block. Returns None when no multi-device mesh is active."""
+    mesh = jax.sharding.get_abstract_mesh()
+    if mesh is not None and mesh.shape:
+        return mesh
+    try:
+        from jax._src.mesh import thread_resources
+
+        physical = thread_resources.env.physical_mesh
+        if not physical.empty:
+            return physical
+    except Exception:
+        pass
+    return None
+
+
+def dot_interaction_fused(
+    stacked: jnp.ndarray,
+    batch_axes: Sequence[str] = ("data", "dp", "batch"),
+    block_batch: int = 128,
+    interpret: bool | None = None,
+) -> jnp.ndarray:
+    """The pallas interaction kernel, runnable under MULTI-DEVICE jit.
+
+    Mosaic kernels cannot be auto-partitioned by XLA, so under a multi-device
+    mesh the kernel is wrapped in ``shard_map`` over the batch axes (the
+    op is embarrassingly parallel in B): each device runs the fused kernel on
+    its local [B/dp, F, D] shard and the surrounding jit keeps dp×tp layouts
+    untouched. Single-device (or no active mesh) falls through to the plain
+    pallas call. ``batch_axes`` lists mesh-axis names that may shard B; any
+    other axes see replicated data."""
+    mesh = _active_mesh()
+    if mesh is None:
+        if jax.device_count() > 1:
+            # a multi-device jit with NO mesh context (plain in_shardings
+            # style) would hand the Mosaic kernel to the auto-partitioner,
+            # which raises NotImplementedError — use the einsum path there
+            return dot_interaction(stacked)
+        return dot_interaction_pallas(stacked, block_batch, interpret)
+    if int(np.prod(list(mesh.shape.values()))) == 1:
+        return dot_interaction_pallas(stacked, block_batch, interpret)
+    from jax.sharding import PartitionSpec as P
+
+    from raydp_tpu.parallel.sharding import shard_map_compat
+
+    present = tuple(a for a in batch_axes if mesh.shape.get(a, 1) > 1)
+    fn = shard_map_compat(
+        partial(dot_interaction_pallas, block_batch=block_batch, interpret=interpret),
+        mesh=mesh,
+        in_specs=P(present if present else None, None, None),
+        out_specs=P(present if present else None, None),
+        # the pallas interpreter can't reconcile invariant grid slices with
+        # varying operands; numerics are test-validated against the einsum
+        check_vma=False,
+    )
+    return fn(stacked)
 
 
 def _interaction_forward(

@@ -219,27 +219,21 @@ def ring_attention_sharded(
     the sequence dim; runs ring_attention under shard_map."""
     from jax.sharding import PartitionSpec as P
 
-    try:
-        from jax import shard_map
-    except ImportError:  # older jax
-        from jax.experimental.shard_map import shard_map
+    from raydp_tpu.parallel.sharding import shard_map_compat
 
     spec = P(None, None, axis, None)
 
-    kwargs = {}
-    if use_flash:
-        # the pallas interpreter can't reconcile invariant grid slices with
-        # varying operands; JAX's documented workaround is check_vma=False
-        # (numerics are validated against full attention in tests)
-        kwargs["check_vma"] = False
-    fn = shard_map(
+    # use_flash: the pallas interpreter can't reconcile invariant grid slices
+    # with varying operands; JAX's documented workaround is check_vma=False
+    # (numerics are validated against full attention in tests)
+    fn = shard_map_compat(
         partial(
             ring_attention, axis_name=axis, causal=causal, use_flash=use_flash
         ),
         mesh=mesh,
         in_specs=(spec, spec, spec),
         out_specs=spec,
-        **kwargs,
+        check_vma=False if use_flash else None,
     )
     return fn(q, k, v)
 

@@ -251,6 +251,22 @@ class SpmdJob:
                 results[i] = future.result()
                 done[i] = True
         deadline = time.monotonic() + wait
+        # Readable sockets are drained on worker THREADS: result() reads a
+        # whole frame under the actor timeout, so one rank streaming a large
+        # or partial frame must not stall detection of other ranks' failures
+        # (the sweep's constant-latency guarantee — the elastic watchdog
+        # depends on it).
+        import queue
+
+        drain_q: "queue.Queue" = queue.Queue()
+        draining: set = set()
+
+        def _drain(idx, fut):
+            try:
+                drain_q.put((idx, fut.result(), None))
+            except BaseException as e:  # noqa: BLE001 — relayed to the sweep
+                drain_q.put((idx, None, e))
+
         while not all(done):
             # ONE poll over every pending rank's socket: sweep latency is
             # constant, not world_size × probe (a dead rank must surface
@@ -259,24 +275,39 @@ class SpmdJob:
             # fds >= FD_SETSIZE, which select() rejects outright.
             pending = [
                 (i, f) for i, f in enumerate(futures)
-                if not done[i] and getattr(f, "_sock", None) is not None
+                if not done[i] and i not in draining
+                and getattr(f, "_sock", None) is not None
             ]
-            with selectors.DefaultSelector() as sel:
-                for i, f in pending:
-                    sel.register(f._sock, selectors.EVENT_READ, i)
-                ready = {key.data for key, _ in sel.select(timeout=0.2)}
-            for i, future in pending:
-                if i not in ready:
-                    continue
+            if pending:
+                with selectors.DefaultSelector() as sel:
+                    for i, f in pending:
+                        sel.register(f._sock, selectors.EVENT_READ, i)
+                    ready = {key.data for key, _ in sel.select(timeout=0.2)}
+                for i, future in pending:
+                    if i not in ready:
+                        continue
+                    draining.add(i)
+                    threading.Thread(
+                        target=_drain, args=(i, future), daemon=True
+                    ).start()
+            # harvest finished drains (block briefly only when every pending
+            # rank is already mid-drain, so the loop still ticks the deadline)
+            block = not pending
+            while True:
                 try:
-                    results[i] = future.result(timeout=0.05)
-                    done[i] = True
-                except TimeoutError:
-                    # a consumed future means the REMOTE function raised
-                    # TimeoutError — that's a rank failure, not our probe
-                    if getattr(future, "_done", False):
-                        raise
-                # ConnectionError / ActorDiedError propagate immediately
+                    i, value, err = drain_q.get(
+                        timeout=0.2 if block else 0.0
+                    )
+                except queue.Empty:
+                    break
+                block = False
+                draining.discard(i)
+                if err is not None:
+                    # rank failure (remote raise / ConnectionError /
+                    # ActorDiedError): fail fast
+                    raise err
+                results[i] = value
+                done[i] = True
             if not all(done) and time.monotonic() > deadline:
                 raise TimeoutError(
                     f"spmd job {self.job_name}: "
