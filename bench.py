@@ -30,18 +30,17 @@ def _maybe_force_cpu():
             pass
 
 
-def make_taxi_frame(session, n_rows: int, parts: int):
-    """Synthetic NYCTaxi-shaped data + the reference pipeline's feature
-    engineering (examples/data_process.py: datetime decomposition, distance)."""
+def make_taxi_source(n_rows: int):
+    """Synthesize the NYCTaxi-shaped SOURCE data (stands in for the CSV the
+    reference examples read from disk — generation is not ETL and is timed
+    separately as data_gen_s)."""
     import pandas as pd
-
-    from raydp_tpu.etl import functions as F
 
     rng = np.random.default_rng(7)
     base = pd.Timestamp("2020-01-01").value // 10**9
     pickup = base + rng.integers(0, 30 * 24 * 3600, n_rows)
     duration = rng.integers(120, 3600, n_rows)
-    pdf = pd.DataFrame(
+    return pd.DataFrame(
         {
             "pickup_ts": pd.to_datetime(pickup, unit="s"),
             "passenger_count": rng.integers(1, 6, n_rows).astype(np.int64),
@@ -54,6 +53,13 @@ def make_taxi_frame(session, n_rows: int, parts: int):
             ),
         }
     )
+
+
+def make_taxi_frame(session, pdf, parts: int):
+    """The reference pipeline's feature engineering (examples/data_process.py:
+    datetime decomposition, distance) on an already-loaded source frame."""
+    from raydp_tpu.etl import functions as F
+
     df = session.from_pandas(pdf, num_partitions=parts)
     df = (
         df.with_column("hour", F.hour("pickup_ts").cast("float32"))
@@ -83,10 +89,14 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     from raydp_tpu.models import MLPRegressor
 
     t0 = time.perf_counter()
+    pdf = make_taxi_source(n_rows)
+    t_gen = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
     session = raydp_tpu.init_etl(
         "bench", num_executors=2, executor_cores=2, executor_memory="1G"
     )
-    df = make_taxi_frame(session, n_rows, parts=8)
+    df = make_taxi_frame(session, pdf, parts=8)
     # ownership transfer + stop: training runs with the ETL engine's CPUs
     # returned (the reference's stop_spark_after_conversion pattern)
     ds = dataframe_to_dataset(df, _use_owner=True)
@@ -123,7 +133,7 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
         est, ds, trained,
         lambda: pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs),
     )
-    return trained, t_etl, cmp
+    return trained, t_gen, t_etl, cmp
 
 
 
@@ -180,7 +190,7 @@ def interleaved_fit_vs_pure(est, ds, trained, pure_fn, n_samples=N_SAMPLES):
         "compile_s": round(max(compiles), 2),
         "train_only_sps": round(trained / fit_s, 1),
         "pure_jax_sps": round(pure_sps, 1),
-        "vs_baseline": round((trained / fit_s) / pure_sps, 4),
+        "train_vs_pure": round((trained / fit_s) / pure_sps, 4),
     }
 
 def pure_jax_throughput(model, loss_fn, x, y, batch: int, epochs: int) -> float:
@@ -232,10 +242,8 @@ DLRM_VOCABS = [100_000, 10_000, 1_000, 1_000, 100, 100]
 DLRM_DENSE = 8
 
 
-def make_criteo_frame(session, n_rows: int, parts: int):
+def make_criteo_source(n_rows: int):
     import pandas as pd
-
-    from raydp_tpu.etl import functions as F
 
     rng = np.random.default_rng(11)
     data = {"label": rng.integers(0, 2, n_rows).astype(np.float32)}
@@ -243,7 +251,13 @@ def make_criteo_frame(session, n_rows: int, parts: int):
         data[f"i{i}"] = rng.integers(0, 1000, n_rows).astype(np.float32)
     for j, vocab in enumerate(DLRM_VOCABS):
         data[f"c{j}"] = rng.integers(0, vocab, n_rows).astype(np.int64)
-    df = session.from_pandas(pd.DataFrame(data), num_partitions=parts)
+    return pd.DataFrame(data)
+
+
+def make_criteo_frame(session, source, parts: int):
+    from raydp_tpu.etl import functions as F
+
+    df = session.from_pandas(source, num_partitions=parts)
     for i in range(DLRM_DENSE):
         df = df.with_column(f"i{i}", F.log1p(F.col(f"i{i}")).cast("float32"))
     for j, vocab in enumerate(DLRM_VOCABS):
@@ -262,10 +276,13 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
         f"c{j}" for j in range(len(DLRM_VOCABS))
     ]
     t0 = time.perf_counter()
+    source = make_criteo_source(n_rows)
+    t_gen = time.perf_counter() - t0
+    t0 = time.perf_counter()
     session = raydp_tpu.init_etl(
         "bench-dlrm", num_executors=2, executor_cores=2, executor_memory="1G"
     )
-    df = make_criteo_frame(session, n_rows, parts=8)
+    df = make_criteo_frame(session, source, parts=8)
     ds = dataframe_to_dataset(df, _use_owner=True)
     raydp_tpu.stop_etl(cleanup_data=False, del_obj_holder=False)
     t_etl = time.perf_counter() - t0
@@ -305,12 +322,102 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
         est, ds, trained,
         lambda: pure_jax_throughput(model, bce, x, y, batch, epochs),
     )
+    e2e_sps = trained / (t_etl + cmp["train_s"])
     return {
+        "data_gen_s": round(t_gen, 2),
         "etl_s": round(t_etl, 2),
-        "e2e_sps": round(trained / (t_etl + cmp["train_s"]), 1),
+        "e2e_sps": round(e2e_sps, 1),
         "rows": n_rows,
         **cmp,
+        # the honest headline per BASELINE.md: END-TO-END (ETL → train)
+        # against the pure-JAX loop; the train-only ratio stays in train_vs_pure
+        "vs_baseline": round(e2e_sps / cmp["pure_jax_sps"], 4),
     }
+
+
+_PARALLEL_BENCH_CODE = r"""
+import json, os, time
+import numpy as np
+import jax
+jax.config.update("jax_platforms", "cpu")
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+from raydp_tpu.parallel import (
+    make_mesh, moe_sharded, pipeline_sharded, ring_attention_sharded,
+)
+
+N = 8
+devices = jax.devices()[:N]
+rng = np.random.default_rng(3)
+out = {}
+
+def timed(name, fn, *args):
+    jax.block_until_ready(fn(*args))  # compile + drain before the clock starts
+    reps = 5
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        r = fn(*args)
+    jax.block_until_ready(r)
+    out[name] = round((time.perf_counter() - t0) / reps * 1000, 2)
+
+# ring attention (sp=8): B1 H8 T_total 1024 D64
+mesh = make_mesh({"sp": N}, devices)
+q = jnp.asarray(rng.standard_normal((1, 8, 1024, 64)), jnp.float32)
+ring = jax.jit(lambda a, b, c: ring_attention_sharded(a, b, c, mesh, causal=True))
+timed("ring_attention_ms", ring, q, q, q)
+
+# pipeline (pp=8)
+pp_mesh = make_mesh({"pp": N}, devices)
+W = jnp.asarray(rng.standard_normal((N, 128, 128)) * 0.1, jnp.float32)
+x = jnp.asarray(rng.standard_normal((64, 128)), jnp.float32)
+pipe = jax.jit(lambda w, t: pipeline_sharded(
+    lambda wi, ti: jax.nn.relu(ti @ wi), w, t, pp_mesh, num_microbatches=N))
+timed("pipeline_ms", pipe, W, x)
+
+# MoE top-2 (ep=8)
+ep_mesh = make_mesh({"ep": N}, devices)
+E = jnp.asarray(rng.standard_normal((N, 128, 128)) * 0.1, jnp.float32)
+R = jnp.asarray(rng.standard_normal((128, N)) * 0.1, jnp.float32)
+tx = jnp.asarray(rng.standard_normal((256, 128)), jnp.float32)
+moe = jax.jit(lambda e, r, t: moe_sharded(
+    lambda wi, ti: jax.nn.relu(ti @ wi), e, r, t, ep_mesh, top_k=2))
+timed("moe_ms", moe, E, R, tx)
+
+print("PARALLEL_JSON:" + json.dumps(out))
+"""
+
+
+def bench_parallel_steps():
+    """Step times of the parallel layer (ring attention, pipeline, MoE) on a
+    virtual 8-device CPU mesh, via a subprocess so the main process's real
+    TPU backend stays untouched. Regressions in parallel/ become visible in
+    the driver artifacts (VERDICT r2 item 10). ok:false on any failure —
+    never discards the run's other numbers."""
+    import subprocess
+
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (
+        env.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+    ).strip()
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _PARALLEL_BENCH_CODE],
+            capture_output=True,
+            text=True,
+            timeout=600,
+            env=env,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+        )
+        for line in res.stdout.splitlines():
+            if line.startswith("PARALLEL_JSON:"):
+                data = json.loads(line[len("PARALLEL_JSON:"):])
+                data["ok"] = True
+                data["n_devices"] = 8
+                return data
+        return {"ok": False, "error": (res.stderr or res.stdout)[-300:]}
+    except Exception as e:  # pragma: no cover
+        return {"ok": False, "error": repr(e)[:200]}
 
 
 def validate_flash_compiled():
@@ -361,7 +468,7 @@ def main():
     batch = int(os.environ.get("BENCH_BATCH", 1024))
     epochs = int(os.environ.get("BENCH_EPOCHS", 3))
 
-    trained, t_etl, cmp = bench_framework(n_rows, batch, epochs)
+    trained, t_gen, t_etl, cmp = bench_framework(n_rows, batch, epochs)
     framework_sps = trained / (t_etl + cmp["train_s"])
 
     # free the NYCTaxi session's holder + blocks before the DLRM measurement
@@ -384,8 +491,11 @@ def main():
         "metric": "nyctaxi_mlp_e2e",
         "value": round(framework_sps, 1),
         "unit": "samples/sec/chip",
-        "vs_baseline": cmp["vs_baseline"],
+        # END-TO-END (ETL → train) vs the pure-JAX loop — BASELINE.md's own
+        # wording; the train-only ratio is reported as train_vs_pure
+        "vs_baseline": round(framework_sps / cmp["pure_jax_sps"], 4),
         "detail": {
+            "data_gen_s": round(t_gen, 2),
             "etl_s": round(t_etl, 2),
             "e2e_sps_incl_etl": round(framework_sps, 1),
             "rows": n_rows,
@@ -393,6 +503,7 @@ def main():
             "epochs": epochs,
             **cmp,
             "dlrm": dlrm,
+            "parallel_steps": bench_parallel_steps(),
             "flash_compiled": validate_flash_compiled(),
         },
     }

@@ -109,6 +109,21 @@ class NodeAgent:
 
         proc = launch_worker(spec, incarnation, self.local_dir, env)
         with self.lock:
+            # a previous incarnation still running here is by definition
+            # stale once the head spawns a newer one (fence-out after a lost
+            # spawn reply): kill it before its children-table entry — and
+            # with it the only pid we hold — is overwritten, or it would
+            # leak as a live process for the life of the node
+            old = self.children.get(spec.actor_id)
+            if (
+                old is not None
+                and old.incarnation != incarnation
+                and old.proc.poll() is None
+            ):
+                try:
+                    os.killpg(old.proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
             self.children[spec.actor_id] = _ChildProc(proc, incarnation)
             self.stats["spawned"] += 1
         return True
@@ -259,6 +274,14 @@ class NodeAgent:
             ),
             timeout=30,
         )
+        # pre-warmed fork template for THIS node's light actors (same role as
+        # the head's zygote; launch_worker routes through it)
+        from raydp_tpu.cluster.common import start_zygote
+
+        try:
+            start_zygote(self.local_dir)
+        except Exception:
+            pass  # spawns fall back to cold subprocess starts
         # publish readiness for whoever launched us
         ready = os.path.join(self.local_dir, "agent_ready.json")
         with open(ready + ".tmp", "w") as f:

@@ -358,8 +358,27 @@ class ActorHandle:
 
     def wait_ready(self, timeout: float = 120.0) -> "ActorHandle":
         deadline = time.monotonic() + timeout
+        use_blocking_wait = True
         while True:
-            record = self._record()
+            record = None
+            if use_blocking_wait:
+                # event-driven: the head parks this call on a condition and
+                # replies the moment the actor turns ALIVE/DEAD — no 50ms
+                # poll overshoot on the startup critical path
+                chunk = min(max(deadline - time.monotonic(), 0.0), 30.0)
+                try:
+                    record = rpc(
+                        resolve_head_addr(self._session_dir),
+                        (
+                            "wait_actor_ready",
+                            {"actor_id": self._actor_id, "timeout": chunk},
+                        ),
+                        timeout=chunk + 10.0,
+                    )
+                except ClusterError:
+                    use_blocking_wait = False  # older head: fall back to polling
+            if not use_blocking_wait:
+                record = self._record()
             if record is not None:
                 if record.state == ActorState.ALIVE:
                     return self
@@ -369,7 +388,8 @@ class ActorHandle:
                     )
             if time.monotonic() > deadline:
                 raise ClusterError(f"timed out waiting for actor {self._name or self._actor_id}")
-            time.sleep(0.05)
+            if not use_blocking_wait:
+                time.sleep(0.05)
 
     def _try_send(self, sock_path: str, method: str, args, kwargs, no_reply: bool,
                   timeout: Optional[float]):

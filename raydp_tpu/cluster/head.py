@@ -114,6 +114,10 @@ class Head:
         self.session_dir = session_dir
         self.driver_pid = driver_pid
         self.lock = threading.RLock()
+        # woken whenever an actor reaches ALIVE or DEAD — lets clients block
+        # in handle_wait_actor_ready instead of sleep-polling get_actor
+        # (polling put ~1.1s of pure sleep on session startup's critical path)
+        self.actor_state_cond = threading.Condition(self.lock)
         self.nodes: Dict[str, NodeRecord] = {}
         self.node_available: Dict[str, Dict[str, float]] = {}
         self.actors: Dict[str, _Actor] = {}
@@ -399,8 +403,12 @@ class Head:
                 except Exception:
                     fenced = False
                     with self.lock:
+                        # ALIVE proves the spawn WAS delivered and the worker
+                        # registered — only the RPC reply was lost; fencing
+                        # would kill a healthy serving actor
                         if actor.incarnation == incarnation and actor.state not in (
                             ActorState.DEAD,
+                            ActorState.ALIVE,
                         ):
                             # credit back what _schedule charged: the retry
                             # path re-schedules (and re-charges) from scratch
@@ -493,7 +501,32 @@ class Head:
                 return False  # stale incarnation raced with a respawn
             actor.sock_path = sock_path
             actor.state = ActorState.ALIVE
+            self.actor_state_cond.notify_all()
             return True
+
+    def handle_wait_actor_ready(self, actor_id: str, timeout: float = 30.0):
+        """Block until the actor is ALIVE or DEAD (or the timeout lapses) and
+        return its record — the event-driven replacement for clients polling
+        get_actor in a sleep loop. Runs on the connection's handler thread;
+        the condition wait releases the head lock. The short re-check period
+        guards against any state transition that forgets to notify."""
+        deadline = time.monotonic() + timeout
+        with self.lock:
+            while True:
+                actor = self.actors.get(actor_id)
+                if actor is not None and actor.state in (
+                    ActorState.ALIVE,
+                    ActorState.DEAD,
+                ):
+                    break
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                self.actor_state_cond.wait(min(remaining, 0.25))
+            if actor is None:
+                return None
+            ip = self.nodes[actor.node_id].node_ip if actor.node_id else None
+            return actor.record(ip)
 
     def handle_actor_init_failed(self, actor_id: str, incarnation: int, error: str):
         with self.lock:
@@ -604,6 +637,7 @@ class Head:
                 pass
         if actor.intentional_exit or actor.restarts_used >= actor.spec.max_restarts:
             actor.state = ActorState.DEAD
+            self.actor_state_cond.notify_all()
             self._on_owner_dead(actor.spec.actor_id)
             if actor.spec.name is not None:
                 # keep the name → id mapping so get_actor(name) reports DEAD
@@ -930,6 +964,14 @@ def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, flo
     # token file — adopt it so outgoing connects authenticate; worker spawns
     # inherit it too
     os.environ[TOKEN_ENV] = token.hex()
+    # pre-warmed fork template: light-actor spawns become ~10ms forks instead
+    # of ~450ms interpreter+pyarrow starts (its warm-up overlaps boot)
+    from raydp_tpu.cluster.common import start_zygote
+
+    try:
+        start_zygote(session_dir)
+    except Exception:
+        pass  # spawns fall back to cold subprocess starts
     head.tcp_addr = f"tcp://{_advertised_ip()}:{tcp_server.server_address[1]}"
     tcp_path = os.path.join(session_dir, HEAD_TCP_FILE)
     with open(tcp_path + ".tmp", "w") as f:

@@ -36,7 +36,6 @@ class EtlEstimatorInterface(ABC):
             )
         return df
 
-    @abstractmethod
     def fit_on_etl(
         self,
         train_df,
@@ -45,7 +44,46 @@ class EtlEstimatorInterface(ABC):
         stop_etl_after_conversion: bool = False,
         max_retries: int = 0,
     ) -> Any:
-        ...
+        """Convert ETL DataFrames and fit. Both exchange paths of the
+        reference (torch/estimator.py:342-359) are supported by EVERY
+        estimator: ``fs_directory`` stages through parquet on a shared
+        filesystem; otherwise blocks go through the object store, with
+        ``stop_etl_after_conversion`` transferring ownership so the data
+        outlives the ETL engine."""
+        import os
+
+        from raydp_tpu.exchange.dataset import (
+            dataframe_to_dataset,
+            dataset_from_parquet,
+        )
+
+        train_df = self._check_and_convert(train_df)
+        if evaluate_df is not None:
+            evaluate_df = self._check_and_convert(evaluate_df)
+
+        if fs_directory is not None:
+            train_dir = os.path.join(fs_directory, "train")
+            train_df.write_parquet(train_dir)
+            train_ds = dataset_from_parquet(train_dir)
+            evaluate_ds = None
+            if evaluate_df is not None:
+                eval_dir = os.path.join(fs_directory, "eval")
+                evaluate_df.write_parquet(eval_dir)
+                evaluate_ds = dataset_from_parquet(eval_dir)
+        else:
+            train_ds = dataframe_to_dataset(
+                train_df, _use_owner=stop_etl_after_conversion
+            )
+            evaluate_ds = None
+            if evaluate_df is not None:
+                evaluate_ds = dataframe_to_dataset(
+                    evaluate_df, _use_owner=stop_etl_after_conversion
+                )
+        if stop_etl_after_conversion:
+            from raydp_tpu.etl.session import stop_etl
+
+            stop_etl(cleanup_data=False, del_obj_holder=False)
+        return self.fit(train_ds, evaluate_ds, max_retries=max_retries)
 
     # migration-friendly alias for users of the reference API
     def fit_on_spark(self, *args, **kwargs):

@@ -768,47 +768,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
     # fit_on_etl (reference fit_on_spark, :332-363)
     # ------------------------------------------------------------------
 
-    def fit_on_etl(
-        self,
-        train_df,
-        evaluate_df=None,
-        fs_directory: Optional[str] = None,
-        stop_etl_after_conversion: bool = False,
-        max_retries: int = 0,
-    ):
-        from raydp_tpu.exchange.dataset import Dataset, dataframe_to_dataset
-
-        train_df = self._check_and_convert(train_df)
-        if evaluate_df is not None:
-            evaluate_df = self._check_and_convert(evaluate_df)
-
-        if fs_directory is not None:
-            # parquet staging path (reference :342-350): write to shared fs,
-            # read back outside the object store
-            train_dir = os.path.join(fs_directory, "train")
-            train_df.write_parquet(train_dir)
-            train_ds = _dataset_from_parquet(train_dir)
-            evaluate_ds = None
-            if evaluate_df is not None:
-                eval_dir = os.path.join(fs_directory, "eval")
-                evaluate_df.write_parquet(eval_dir)
-                evaluate_ds = _dataset_from_parquet(eval_dir)
-        else:
-            train_ds = dataframe_to_dataset(
-                train_df, _use_owner=stop_etl_after_conversion
-            )
-            evaluate_ds = None
-            if evaluate_df is not None:
-                evaluate_ds = dataframe_to_dataset(
-                    evaluate_df, _use_owner=stop_etl_after_conversion
-                )
-
-        if stop_etl_after_conversion:
-            from raydp_tpu.etl.session import stop_etl
-
-            stop_etl(cleanup_data=False, del_obj_holder=False)
-
-        return self.fit(train_ds, evaluate_ds, max_retries=max_retries)
+    # fit_on_etl (both exchange paths, incl. fs_directory parquet staging)
+    # is inherited from EtlEstimatorInterface — shared by every estimator
 
     # ------------------------------------------------------------------
     # checkpointing (orbax; reference uses AIR Checkpoint dicts :243-250)
@@ -881,7 +842,3 @@ def latest_checkpoint_epoch(checkpoint_dir: Optional[str]) -> Optional[int]:
     return max(epochs) if epochs else None
 
 
-def _dataset_from_parquet(directory: str):
-    from raydp_tpu.exchange.dataset import dataset_from_parquet
-
-    return dataset_from_parquet(directory)

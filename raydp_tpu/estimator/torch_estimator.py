@@ -219,29 +219,8 @@ class TorchEstimator(EstimatorInterface, EtlEstimatorInterface):
                 if attempts > max_retries:
                     raise
 
-    def fit_on_etl(
-        self,
-        train_df,
-        evaluate_df=None,
-        fs_directory: Optional[str] = None,
-        stop_etl_after_conversion: bool = False,
-        max_retries: int = 0,
-    ):
-        from raydp_tpu.exchange.dataset import dataframe_to_dataset
-
-        train_df = self._check_and_convert(train_df)
-        train_ds = dataframe_to_dataset(train_df, _use_owner=stop_etl_after_conversion)
-        evaluate_ds = None
-        if evaluate_df is not None:
-            evaluate_ds = dataframe_to_dataset(
-                self._check_and_convert(evaluate_df),
-                _use_owner=stop_etl_after_conversion,
-            )
-        if stop_etl_after_conversion:
-            from raydp_tpu.etl.session import stop_etl
-
-            stop_etl(cleanup_data=False, del_obj_holder=False)
-        return self.fit(train_ds, evaluate_ds, max_retries=max_retries)
+    # fit_on_etl (incl. the fs_directory parquet staging path) is inherited
+    # from EtlEstimatorInterface — shared by every estimator
 
     def get_model(self):
         import torch

@@ -82,6 +82,18 @@ def _start_tracker(n_workers: int):
 
 
 class XGBoostEstimator(EstimatorInterface, EtlEstimatorInterface):
+    """Distributed GBDT (reference xgboost/estimator.py:31-116). Two
+    backends behind one API:
+
+    - ``xgboost``: xgboost's own collective training across this framework's
+      SPMD rank actors, rendezvousing at a driver-hosted RabitTracker;
+    - ``native``: the in-repo distributed histogram GBDT
+      (estimator/gbdt_native.py) — same sharded-data/reduced-histograms
+      shape, no external dependency.
+
+    ``backend="auto"`` (default) picks xgboost when installed, else native.
+    """
+
     def __init__(
         self,
         params: Optional[Dict[str, Any]] = None,
@@ -89,20 +101,27 @@ class XGBoostEstimator(EstimatorInterface, EtlEstimatorInterface):
         feature_columns: Optional[Sequence[str]] = None,
         label_column: Optional[str] = None,
         num_workers: int = 1,
+        backend: str = "auto",
     ):
-        if not _have_xgboost():
+        if backend not in ("auto", "xgboost", "native"):
+            raise ValueError(f"unknown backend {backend!r}")
+        if backend == "auto":
+            backend = "xgboost" if _have_xgboost() else "native"
+        if backend == "xgboost" and not _have_xgboost():
             raise ImportError(
-                "XGBoostEstimator requires the 'xgboost' package, which is not "
-                "installed in this environment. Install xgboost to use "
-                "distributed GBDT training; TPU-accelerated workloads should "
-                "use JaxEstimator instead."
+                "XGBoostEstimator(backend='xgboost') requires the 'xgboost' "
+                "package, which is not installed. Use backend='native' (or "
+                "'auto') for the built-in distributed histogram GBDT."
             )
+        self.backend = backend
         self.params = dict(params or {"objective": "reg:squarederror"})
         self.num_boost_round = num_boost_round
         self.feature_columns = list(feature_columns or [])
         self.label_column = label_column
         self.num_workers = num_workers
         self._raw_model: Optional[str] = None
+        self._native_model = None
+        self._history: List[Dict[str, float]] = []
 
     def fit(self, train_ds, evaluate_ds=None, max_retries: int = 0):
         from raydp_tpu.spmd import create_spmd_job
@@ -116,6 +135,20 @@ class XGBoostEstimator(EstimatorInterface, EtlEstimatorInterface):
                     if evaluate_ds is not None
                     else None
                 )
+                if self.backend == "native":
+                    from raydp_tpu.estimator import gbdt_native
+
+                    job = create_spmd_job(world_size=self.num_workers).start()
+                    try:
+                        booster, history = gbdt_native.train_distributed(
+                            job, shards, self.params, self.num_boost_round,
+                            self.feature_columns, self.label_column,
+                        )
+                    finally:
+                        job.stop()
+                    self._native_model = booster
+                    self._history = history
+                    return booster
                 cfg = {
                     "params": self.params,
                     "num_boost_round": self.num_boost_round,
@@ -143,32 +176,14 @@ class XGBoostEstimator(EstimatorInterface, EtlEstimatorInterface):
                 if attempts > max_retries:
                     raise
 
-    def fit_on_etl(
-        self,
-        train_df,
-        evaluate_df=None,
-        fs_directory: Optional[str] = None,
-        stop_etl_after_conversion: bool = False,
-        max_retries: int = 0,
-    ):
-        from raydp_tpu.exchange.dataset import dataframe_to_dataset
-
-        train_ds = dataframe_to_dataset(
-            self._check_and_convert(train_df), _use_owner=stop_etl_after_conversion
-        )
-        evaluate_ds = None
-        if evaluate_df is not None:
-            evaluate_ds = dataframe_to_dataset(
-                self._check_and_convert(evaluate_df),
-                _use_owner=stop_etl_after_conversion,
-            )
-        if stop_etl_after_conversion:
-            from raydp_tpu.etl.session import stop_etl
-
-            stop_etl(cleanup_data=False, del_obj_holder=False)
-        return self.fit(train_ds, evaluate_ds, max_retries=max_retries)
+    # fit_on_etl (incl. the fs_directory parquet staging path) is inherited
+    # from EtlEstimatorInterface — shared by every estimator
 
     def get_model(self):
+        if self.backend == "native":
+            if self._native_model is None:
+                raise RuntimeError("call fit() first")
+            return self._native_model
         import xgboost as xgb
 
         if self._raw_model is None:
@@ -176,3 +191,8 @@ class XGBoostEstimator(EstimatorInterface, EtlEstimatorInterface):
         booster = xgb.Booster()
         booster.load_model(bytearray(self._raw_model.encode("latin1")))
         return booster
+
+    @property
+    def history(self) -> List[Dict[str, float]]:
+        """Per-round train loss (native backend)."""
+        return self._history

@@ -122,9 +122,11 @@ class EtlSession:
         # (python -S, skipping sitecustomize's ~2.6s jax+TPU preimport;
         # override with etl.actor.light=False for jax-using UDFs)
         self._light_actors = bool(self.configs.get("etl.actor.light", True))
+        # spawned non-blocking so the master's process startup overlaps the
+        # executors' (they are independent); readiness is gathered below
         self.master = cluster.spawn(
             ObjectHolder, name=f"{app_name}{MASTER_ACTOR_SUFFIX}",
-            max_restarts=0, light=self._light_actors,
+            max_restarts=0, light=self._light_actors, block=False,
         )
 
         # executor pool: restartable actors (parity: setMaxRestarts(3),
@@ -168,6 +170,7 @@ class EtlSession:
             self.executors.append(handle)
         for handle in self.executors:
             handle.wait_ready()
+        self.master.wait_ready()
         self._next_executor_id = num_executors
 
         self._planner = Planner(

@@ -80,9 +80,11 @@ def _active_mesh():
     """The mesh governing the current trace: the new-style context
     (``jax.set_mesh`` / ``use_abstract_mesh``) or the legacy ``with mesh:``
     block. Returns None when no multi-device mesh is active."""
-    mesh = jax.sharding.get_abstract_mesh()
-    if mesh is not None and mesh.shape:
-        return mesh
+    get_abstract = getattr(jax.sharding, "get_abstract_mesh", None)
+    if get_abstract is not None:
+        mesh = get_abstract()
+        if mesh is not None and mesh.shape:
+            return mesh
     try:
         from jax._src.mesh import thread_resources
 

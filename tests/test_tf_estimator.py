@@ -33,8 +33,10 @@ def _keras_model():
     )
 
 
-@pytest.mark.parametrize("num_workers", [1, 2])
-def test_tf_fit_on_etl(session, num_workers):
+@pytest.mark.parametrize(
+    "num_workers,use_fs_directory", [(1, False), (2, False), (2, True)]
+)
+def test_tf_fit_on_etl(session, tmp_path, num_workers, use_fs_directory):
     from raydp_tpu.estimator import TFEstimator
 
     rng = np.random.default_rng(0)
@@ -56,7 +58,8 @@ def test_tf_fit_on_etl(session, num_workers):
         num_workers=num_workers,
         seed=0,
     )
-    history = est.fit_on_etl(df)
+    kwargs = {"fs_directory": str(tmp_path / "stage")} if use_fs_directory else {}
+    history = est.fit_on_etl(df, **kwargs)
     losses = history["loss"]
     assert len(losses) == 8
     assert losses[-1] < losses[0] * 0.5

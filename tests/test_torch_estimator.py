@@ -30,7 +30,8 @@ def _make_model():
     )
 
 
-def test_torch_fit_on_etl(session):
+@pytest.mark.parametrize("use_fs_directory", [False, True])
+def test_torch_fit_on_etl(session, tmp_path, use_fs_directory):
     import torch
 
     rng = np.random.default_rng(0)
@@ -52,7 +53,8 @@ def test_torch_fit_on_etl(session):
         learning_rate=1e-2,
         seed=0,
     )
-    history = est.fit_on_etl(df)
+    kwargs = {"fs_directory": str(tmp_path / "stage")} if use_fs_directory else {}
+    history = est.fit_on_etl(df, **kwargs)
     assert len(history) == 8
     assert history[-1]["train_loss"] < history[0]["train_loss"] * 0.2
 
