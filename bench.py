@@ -484,7 +484,9 @@ def main():
     dlrm = bench_dlrm(
         int(os.environ.get("BENCH_DLRM_ROWS", 100_000)),
         int(os.environ.get("BENCH_DLRM_BATCH", 2048)),
-        int(os.environ.get("BENCH_DLRM_EPOCHS", 2)),
+        # 4 epochs (reference DLRM notebook trains 30): amortizes the fixed
+        # ETL cost over a realistic-but-short training run
+        int(os.environ.get("BENCH_DLRM_EPOCHS", 4)),
     )
 
     result = {

@@ -1,0 +1,49 @@
+"""Pure-ETL pipeline — the reference's data_process.py: load, feature
+engineering, groupby aggregation, join, sorted report — exercising the
+distributed DataFrame engine with no training stage."""
+
+import os
+
+import raydp_tpu
+from raydp_tpu.etl import functions as F
+
+from nyctaxi_jax import synthetic_taxi
+
+
+def main():
+    session = raydp_tpu.init_etl(
+        "data-process", num_executors=2, executor_cores=2, executor_memory="1G"
+    )
+    rows = int(os.environ.get("EXAMPLE_ROWS", 100_000))
+    df = session.from_pandas(synthetic_taxi(rows), num_partitions=8)
+
+    trips = (
+        df.with_column("hour", F.hour("pickup_ts"))
+        .with_column("dow", F.dayofweek("pickup_ts"))
+        .with_column("fare", F.col("fare_amount").cast("float64"))
+        .select("hour", "dow", "passenger_count", "fare")
+        .filter(F.col("fare") > 0)
+    )
+
+    by_hour = trips.groupby("hour").agg(
+        trips=("count", "*"), avg_fare=("mean", "fare")
+    )
+    by_dow = trips.groupby("dow").agg(dow_trips=("count", "*"))
+
+    # join hourly stats against day-of-week volume and report the busiest
+    report = (
+        trips.groupby("hour", "dow")
+        .agg(n=("count", "*"), fare_sum=("sum", "fare"))
+        .join(by_hour, on="hour")
+        .join(by_dow, on="dow")
+        .sort("n", ascending=False)
+        .limit(10)
+        .to_pandas()
+    )
+    print(report.to_string(index=False))
+    print("total trips:", trips.count())
+    raydp_tpu.stop_etl()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,66 @@
+"""NYCTaxi with TFEstimator — the reference's tensorflow_nyctaxi.py
+(examples/tensorflow_nyctaxi.py:20-22) on this framework: keras MLP trained
+with MultiWorkerMirroredStrategy ranks on the SPMD launcher."""
+
+import os
+
+import raydp_tpu
+from raydp_tpu.estimator import TFEstimator
+from raydp_tpu.etl import functions as F
+
+from nyctaxi_jax import synthetic_taxi
+
+
+def make_model():
+    import tensorflow as tf
+
+    return tf.keras.Sequential(
+        [
+            tf.keras.layers.Input(shape=(4,)),
+            tf.keras.layers.Dense(64, activation="relu"),
+            tf.keras.layers.Dense(32, activation="relu"),
+            tf.keras.layers.Dense(1),
+        ]
+    )
+
+
+def main():
+    import tensorflow as tf
+
+    session = raydp_tpu.init_etl(
+        "nyctaxi-tf", num_executors=2, executor_cores=1, executor_memory="500M"
+    )
+    rows = int(os.environ.get("EXAMPLE_ROWS", 100_000))
+    df = session.from_pandas(synthetic_taxi(rows), num_partitions=4)
+    df = (
+        df.with_column("hour", F.hour("pickup_ts").cast("float32"))
+        .with_column("dow", F.dayofweek("pickup_ts").cast("float32"))
+        .with_column("dx", F.col("dropoff_longitude") - F.col("pickup_longitude"))
+        .with_column("dy", F.col("dropoff_latitude") - F.col("pickup_latitude"))
+        .with_column(
+            "dist",
+            F.sqrt(F.col("dx") * F.col("dx") + F.col("dy") * F.col("dy")).cast("float32"),
+        )
+        .with_column("pc", F.col("passenger_count").cast("float32"))
+        .with_column("label", F.col("fare_amount").cast("float32"))
+        .select("hour", "dow", "dist", "pc", "label")
+    )
+
+    est = TFEstimator(
+        model=make_model,
+        optimizer=tf.keras.optimizers.Adam(0.01),
+        loss="mse",
+        metrics=["mae"],
+        feature_columns=["hour", "dow", "dist", "pc"],
+        label_column="label",
+        batch_size=64,
+        num_epochs=int(os.environ.get("EXAMPLE_EPOCHS", 5)),
+        num_workers=2,
+        seed=0,
+    )
+    history = est.fit_on_etl(df)
+    print("losses:", [round(v, 4) for v in history["loss"]])
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,67 @@
+"""NYCTaxi with TorchEstimator — the reference's pytorch_nyctaxi.py
+(examples/pytorch_nyctaxi.py:22-24,71-75) on this framework: same ETL
+pipeline, torch MLP trained with DDP (gloo) ranks on the SPMD launcher."""
+
+import os
+
+import raydp_tpu
+from raydp_tpu.estimator import TorchEstimator
+from raydp_tpu.etl import functions as F
+
+from nyctaxi_jax import synthetic_taxi  # same feature pipeline source
+
+
+def make_model():
+    import torch
+
+    return torch.nn.Sequential(
+        torch.nn.Linear(4, 64),
+        torch.nn.ReLU(),
+        torch.nn.Linear(64, 32),
+        torch.nn.ReLU(),
+        torch.nn.Linear(32, 1),
+    )
+
+
+def main():
+    import torch
+
+    session = raydp_tpu.init_etl(
+        "nyctaxi-torch", num_executors=2, executor_cores=1, executor_memory="500M"
+    )
+    rows = int(os.environ.get("EXAMPLE_ROWS", 100_000))
+    df = session.from_pandas(synthetic_taxi(rows), num_partitions=4)
+    df = (
+        df.with_column("hour", F.hour("pickup_ts").cast("float32"))
+        .with_column("dow", F.dayofweek("pickup_ts").cast("float32"))
+        .with_column("dx", F.col("dropoff_longitude") - F.col("pickup_longitude"))
+        .with_column("dy", F.col("dropoff_latitude") - F.col("pickup_latitude"))
+        .with_column(
+            "dist",
+            F.sqrt(F.col("dx") * F.col("dx") + F.col("dy") * F.col("dy")).cast("float32"),
+        )
+        .with_column("pc", F.col("passenger_count").cast("float32"))
+        .with_column("label", F.col("fare_amount").cast("float32"))
+        .select("hour", "dow", "dist", "pc", "label")
+    )
+
+    est = TorchEstimator(
+        model=make_model,
+        optimizer="Adam",
+        loss=torch.nn.MSELoss,
+        feature_columns=["hour", "dow", "dist", "pc"],
+        label_column="label",
+        batch_size=64,
+        num_epochs=int(os.environ.get("EXAMPLE_EPOCHS", 5)),
+        num_workers=2,
+        learning_rate=1e-2,
+        seed=0,
+    )
+    history = est.fit_on_etl(df)
+    for record in history:
+        print(record)
+    print("final train_loss", history[-1]["train_loss"])
+
+
+if __name__ == "__main__":
+    main()

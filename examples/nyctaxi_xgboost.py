@@ -1,0 +1,46 @@
+"""NYCTaxi with XGBoostEstimator — the reference's xgboost_ray_nyctaxi.py
+(examples/xgboost_ray_nyctaxi.py:41-47) on this framework: distributed GBDT
+over SPMD rank actors. Runs on xgboost's collective when installed, otherwise
+on the built-in distributed histogram GBDT (estimator/gbdt_native.py)."""
+
+import os
+
+import numpy as np
+
+import raydp_tpu
+from raydp_tpu.estimator import XGBoostEstimator
+from raydp_tpu.etl import functions as F
+
+from nyctaxi_jax import synthetic_taxi
+
+
+def main():
+    session = raydp_tpu.init_etl(
+        "nyctaxi-xgb", num_executors=2, executor_cores=1, executor_memory="500M"
+    )
+    rows = int(os.environ.get("EXAMPLE_ROWS", 100_000))
+    df = session.from_pandas(synthetic_taxi(rows), num_partitions=4)
+    df = (
+        df.with_column("hour", F.hour("pickup_ts").cast("float32"))
+        .with_column("dow", F.dayofweek("pickup_ts").cast("float32"))
+        .with_column("pc", F.col("passenger_count").cast("float32"))
+        .with_column("label", F.col("fare_amount").cast("float32"))
+        .select("hour", "dow", "pc", "label")
+    )
+
+    est = XGBoostEstimator(
+        params={"objective": "reg:squarederror", "eta": 0.3, "max_depth": 5},
+        num_boost_round=int(os.environ.get("EXAMPLE_ROUNDS", 10)),
+        feature_columns=["hour", "dow", "pc"],
+        label_column="label",
+        num_workers=2,
+    )
+    est.fit_on_etl(df)
+    model = est.get_model()
+    print("backend:", est.backend)
+    sample = np.array([[12.0, 3.0, 2.0]])
+    print("prediction for noon/wed/2pax:", float(np.asarray(model.predict(sample)).reshape(-1)[0]))
+
+
+if __name__ == "__main__":
+    main()

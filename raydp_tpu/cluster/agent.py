@@ -158,13 +158,10 @@ class NodeAgent:
         return data
 
     def handle_unlink_shm(self, shm_names: List[str]):
-        from raydp_tpu.cluster.common import safe_shm_name
+        from raydp_tpu.cluster.common import unlink_block
 
         for name in shm_names:
-            try:
-                os.unlink(os.path.join("/dev/shm", safe_shm_name(name)))
-            except (OSError, ClusterError):
-                pass
+            unlink_block(name)
         return True
 
     def handle_stop(self):
@@ -298,6 +295,9 @@ class NodeAgent:
 
 def main() -> None:
     head_addr, node_ip, shm_ns, local_dir, resources_json = sys.argv[1:6]
+    # anchor the serving root: the spill-path sanitizer pins file:// block
+    # reads/unlinks to THIS node's spill dir
+    os.environ[SESSION_ENV] = local_dir
     agent = NodeAgent(
         head_addr, node_ip, json.loads(resources_json), shm_ns, local_dir
     )

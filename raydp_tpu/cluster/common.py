@@ -255,14 +255,49 @@ def head_sock_path(session_dir: str) -> str:
     return os.path.join(session_dir, HEAD_SOCK_NAME)
 
 
+def safe_spill_path(name: str) -> str:
+    """Validate a ``file://`` block location before serving/unlinking it: the
+    resolved path must be a framework spill file (rtpu- prefixed) DIRECTLY
+    inside this process's own spill root (``$RAYDP_TPU_SESSION/spill`` —
+    head_main/agent anchor it at boot) — a client-supplied path must not be
+    able to read or remove arbitrary files, nor another session's spill."""
+    path = os.path.realpath(name[len("file://"):])
+    base = os.path.basename(path)
+    if not base.startswith("rtpu-"):
+        raise ClusterError(f"invalid spill block path {name!r}")
+    session = os.environ.get(SESSION_ENV)
+    if not session:
+        raise ClusterError(
+            f"cannot serve spill path {name!r}: no session root anchored"
+        )
+    root = os.path.realpath(os.path.join(session, "spill"))
+    if os.path.dirname(path) != root:
+        raise ClusterError(f"spill path {name!r} outside this node's spill dir")
+    return path
+
+
 def serve_block_bytes(shm_name: str, offset: int = 0, length: int = -1) -> bytes:
-    """Read a local /dev/shm segment for a remote reader (the block-server
-    primitive shared by the head and node agents — one copy of the
-    sanitize/seek/length logic)."""
-    path = os.path.join("/dev/shm", safe_shm_name(shm_name))
+    """Read a local block for a remote reader (the block-server primitive
+    shared by the head and node agents — one copy of the sanitize/seek/length
+    logic). Serves both tiers: /dev/shm segments and ``file://`` spill files."""
+    if shm_name.startswith("file://"):
+        path = safe_spill_path(shm_name)
+    else:
+        path = os.path.join("/dev/shm", safe_shm_name(shm_name))
     with open(path, "rb") as f:
         f.seek(offset)
         return f.read() if length < 0 else f.read(length)
+
+
+def unlink_block(shm_name: str) -> None:
+    """Remove a block in either tier (shared by head and agents)."""
+    try:
+        if shm_name.startswith("file://"):
+            os.unlink(safe_spill_path(shm_name))
+        else:
+            os.unlink(os.path.join("/dev/shm", safe_shm_name(shm_name)))
+    except (OSError, ClusterError):
+        pass
 
 
 class ZygoteProc:

@@ -767,6 +767,20 @@ class Head:
             else:
                 threading.Thread(target=_fire, daemon=True).start()
 
+    def handle_object_reown_all(self, old_owner: str, new_owner: str) -> int:
+        """Transfer EVERY live object owned by ``old_owner`` to ``new_owner``
+        — the graceful-scale-down primitive: executors killed by dynamic
+        allocation (or kill_executors) must not take still-referenced blocks
+        with them (their shm segments/spill files survive the process; only
+        owner-death GC would destroy them)."""
+        moved = 0
+        with self.lock:
+            for meta in self.objects.values():
+                if meta.owner == old_owner and not meta.owner_died:
+                    meta.owner = new_owner
+                    moved += 1
+        return moved
+
     def handle_object_owner_of(self, object_id: str):
         with self.lock:
             meta = self.objects.get(object_id)
@@ -774,10 +788,9 @@ class Head:
 
     @staticmethod
     def _unlink_shm(shm_name: str) -> None:
-        try:
-            os.unlink(os.path.join("/dev/shm", shm_name.lstrip("/")))
-        except OSError:
-            pass
+        from raydp_tpu.cluster.common import unlink_block
+
+        unlink_block(shm_name)
 
     def _on_owner_dead(self, owner: str) -> None:
         dead = []

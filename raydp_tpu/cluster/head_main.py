@@ -12,6 +12,11 @@ from raydp_tpu.cluster.head import run_head
 
 def main() -> None:
     session_dir = sys.argv[1]
+    # anchor the serving root: the spill-path sanitizer pins file:// block
+    # reads/unlinks to THIS session's spill dir
+    from raydp_tpu.cluster.common import SESSION_ENV
+
+    os.environ[SESSION_ENV] = session_dir
     with open(os.path.join(session_dir, "head_boot.pkl"), "rb") as f:
         driver_pid, default_resources = cloudpickle.load(f)
     # the cluster's shared secret, written before any socket exists; the

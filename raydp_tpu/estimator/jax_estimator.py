@@ -158,6 +158,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         sync_every_steps: int = 32,
         scan_epochs: Optional[bool] = None,
         scan_memory_limit: int = 1 << 30,
+        save_every_steps: Optional[int] = None,
     ):
         self._model_arg = model
         self._optimizer_arg = optimizer
@@ -196,6 +197,13 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         # gathers shuffled batches there, so H2D happens once per fit.
         self.scan_epochs = scan_epochs
         self.scan_memory_limit = scan_memory_limit
+        # step-cadence checkpointing: every K completed steps write
+        # epoch_N_step_K (a long epoch on a pod must not lose everything
+        # since the last epoch boundary). resume_from_epoch accepts either
+        # an int (epoch complete) or an (epoch, step) tuple to continue
+        # mid-epoch — batch order is deterministic per (seed, epoch), so the
+        # resumed run replays exactly the tail steps.
+        self.save_every_steps = save_every_steps
 
         self._module = None
         self._params = None
@@ -334,7 +342,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             and self.checkpoint_dir
             and jax.process_count() == 1
         )
-        baseline_epoch = self._latest_checkpoint_epoch() if retry_resume else None
+
+        def _key(es):
+            return (es[0], float("inf") if es[1] is None else es[1])
+
+        baseline = latest_checkpoint(self.checkpoint_dir) if retry_resume else None
         saved_resume = self.resume_from_epoch
         try:
             while True:
@@ -345,17 +357,22 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     if attempts > max_retries:
                         raise
                     if retry_resume:
-                        latest = self._latest_checkpoint_epoch()
+                        latest = latest_checkpoint(self.checkpoint_dir)
                         if latest is not None and (
-                            baseline_epoch is None or latest > baseline_epoch
+                            baseline is None or _key(latest) > _key(baseline)
                         ):
-                            # never resume past the end: a crash after the
-                            # final epoch's checkpoint would start at
-                            # num_epochs and return an empty history —
-                            # re-run at least the final epoch instead
-                            resume = min(latest, self.num_epochs - 2)
-                            if resume >= 0:
-                                self.resume_from_epoch = resume
+                            epoch, step = latest
+                            if step is not None:
+                                # mid-epoch checkpoint: replay only the tail
+                                self.resume_from_epoch = (epoch, step)
+                            else:
+                                # never resume past the end: a crash after
+                                # the final epoch's checkpoint would start at
+                                # num_epochs and return an empty history —
+                                # re-run at least the final epoch instead
+                                resume = min(epoch, self.num_epochs - 2)
+                                if resume >= 0:
+                                    self.resume_from_epoch = resume
                     time.sleep(1.0)
         finally:
             # retries must not leak resume state into a later fit() call
@@ -458,17 +475,26 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         eval_step = self._make_eval_step(module, loss_fn)
 
         start_epoch = 0
+        start_step = 0
         if self.resume_from_epoch is not None:
             # step-level resume (beyond the reference's model-only
             # checkpointing, SURVEY.md §5): reload params at the checkpointed
-            # epoch and continue — the recovery path when a slice fails
+            # (epoch[, step]) and continue — the recovery path when a slice
+            # fails. An (epoch, step) tuple resumes MID-epoch, replaying only
+            # the tail steps (batch order is deterministic per seed+epoch).
             if not self.checkpoint_dir:
                 raise ValueError("resume_from_epoch requires checkpoint_dir")
+            resume = self.resume_from_epoch
+            resume_epoch, resume_step = (
+                resume if isinstance(resume, tuple) else (resume, None)
+            )
             template = {
                 "params": jax.device_get(params),
                 "opt_state": jax.device_get(opt_state),
             }
-            restored = self._restore_checkpoint(self.resume_from_epoch, template)
+            restored = self._restore_checkpoint(
+                resume_epoch, template, step=resume_step
+            )
             params = jax.device_put(
                 restored["params"], jax.tree.map(lambda p: p.sharding, params)
             )
@@ -476,7 +502,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             # places leaves to match params (the live opt_state's scalar
             # leaves are uncommitted too)
             opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
-            start_epoch = self.resume_from_epoch + 1
+            if resume_step is None:
+                start_epoch = resume_epoch + 1
+            else:
+                start_epoch = resume_epoch
+                start_step = resume_step
 
         import contextlib
 
@@ -493,20 +523,40 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             run_scan_epoch = self._build_scan_runner(
                 train_source, batch_size, mesh, step_impl, donate
             )
+            save_steps = self.save_every_steps if self.checkpoint_dir else None
+
+            def save_mid_epoch(params_, opt_state_, epoch_, step_):
+                self._save_checkpoint(params_, epoch_, opt_state_, step=step_)
+
             for epoch in range(start_epoch, self.num_epochs):
                 epoch_start = time.perf_counter()
                 epoch_seed = None if not self.shuffle else self.seed + epoch
+                epoch_start_step = start_step if epoch == start_epoch else 0
                 if run_scan_epoch is not None:
                     params, opt_state, loss_sum, steps = run_scan_epoch(
-                        params, opt_state, epoch_seed
+                        params, opt_state, epoch_seed,
+                        start_step=epoch_start_step,
+                        save_cb=(
+                            (lambda p, o, s, _e=epoch: save_mid_epoch(p, o, _e, s))
+                            if save_steps
+                            else None
+                        ),
                     )
                 else:
-                    train_iter = PrefetchingDeviceIterator(
-                        self._epoch_batches(train_source, batch_size, epoch_seed),
-                        mesh,
+                    host_iter = self._epoch_batches(
+                        train_source, batch_size, epoch_seed
                     )
+                    if epoch_start_step:
+                        # deterministic order per (seed, epoch): dropping the
+                        # first K batches replays exactly the un-run tail
+                        import itertools
+
+                        host_iter = itertools.islice(
+                            host_iter, epoch_start_step, None
+                        )
+                    train_iter = PrefetchingDeviceIterator(host_iter, mesh)
                     loss_sum = jnp.zeros((), jnp.float32)
-                    steps = 0
+                    steps = epoch_start_step
                     for x, y in train_iter:
                         if not first_step_done:
                             # the first call compiles (cold TPU compiles take
@@ -524,12 +574,28 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                                 params, opt_state, loss_sum, x, y
                             )
                         steps += 1
+                        if save_steps and steps % save_steps == 0:
+                            # an epoch_N_step_S checkpoint where S happens to
+                            # be the final step is fine: the epoch-complete
+                            # epoch_N save below supersedes it, and a resume
+                            # from (N, S) runs zero tail steps and records no
+                            # bogus epoch (empty resumed epochs are skipped)
+                            save_mid_epoch(params, opt_state, epoch, steps)
                         if (
                             self.sync_every_steps
                             and steps % self.sync_every_steps == 0
                         ):
                             # bounded pipeline bubble; see __init__ comment
                             jax.block_until_ready(loss_sum)
+                    steps -= epoch_start_step
+                if steps == 0 and epoch_start_step > 0:
+                    # resumed exactly at this epoch's end (the newest
+                    # checkpoint was epoch_N_step_<last>): nothing trained —
+                    # recording a zero-loss epoch would poison downstream
+                    # metrics; just finalize the epoch and move on
+                    if self.checkpoint_dir:
+                        self._save_checkpoint(params, epoch, opt_state)
+                    continue
                 # defer the host read: float(loss_sum) here would sync the
                 # pipeline every epoch; store the device scalar instead
                 record: Dict[str, Any] = {
@@ -613,7 +679,15 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             )
             return params, opt_state, loss_sum
 
-        state: Dict[str, Any] = {"compiled": None}
+        # segment cap: save_every_steps chunks the epoch into several scans
+        # with a checkpoint after each (mid-epoch recovery); otherwise ONE
+        # scan covers the whole epoch. Distinct segment lengths (the tail)
+        # compile once each and are cached. Gated on checkpoint_dir exactly
+        # like the save callback: save_every_steps without a checkpoint dir
+        # must not pay segmentation overhead for zero checkpointing benefit.
+        save_every = self.save_every_steps if self.checkpoint_dir else None
+        seg_cap = min(save_every or steps_per_epoch, steps_per_epoch)
+        compiled: Dict[int, Any] = {}
 
         def _order(seed):
             order = np.arange(n)
@@ -634,62 +708,68 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 xs_dev = jnp.asarray(feats)
                 ys_dev = jnp.asarray(labs)
 
-            def epoch_gather(params, opt_state, xs, ys, perm):
-                xb = xs[perm].reshape(steps_per_epoch, batch_size, feat_dim)
-                yb = ys[perm].reshape(
-                    (steps_per_epoch, batch_size) + ys.shape[1:]
+            def make_gather(length):
+                def seg_gather(params, opt_state, xs, ys, perm):
+                    xb = xs[perm].reshape(length, batch_size, feat_dim)
+                    yb = ys[perm].reshape((length, batch_size) + ys.shape[1:])
+                    return epoch_body(params, opt_state, xb, yb)
+
+                return jax.jit(seg_gather, donate_argnums=(0, 1) if donate else ())
+
+            def run_segment(params, opt_state, order, start, length):
+                perm = jnp.asarray(
+                    order[start * batch_size : (start + length) * batch_size]
                 )
-                return epoch_body(params, opt_state, xb, yb)
-
-            jitted = jax.jit(
-                epoch_gather, donate_argnums=(0, 1) if donate else ()
-            )
-
-            def run_epoch(params, opt_state, seed):
-                perm = jnp.asarray(_order(seed))
-                if state["compiled"] is None:
+                if length not in compiled:
                     t0 = time.perf_counter()
-                    state["compiled"] = jitted.lower(
-                        params, opt_state, xs_dev, ys_dev, perm
+                    compiled[length] = (
+                        make_gather(length)
+                        .lower(params, opt_state, xs_dev, ys_dev, perm)
+                        .compile()
+                    )
+                    self.compile_seconds_ += time.perf_counter() - t0
+                return compiled[length](params, opt_state, xs_dev, ys_dev, perm)
+
+        else:
+            x_sharding = NamedSharding(mesh, PartitionSpec(None, "data", None))
+            y_sharding = NamedSharding(
+                mesh, PartitionSpec(None, "data", *([None] * (labs.ndim - 1)))
+            )
+            jitted = jax.jit(epoch_body, donate_argnums=(0, 1) if donate else ())
+
+            def run_segment(params, opt_state, order, start, length):
+                sel = order[start * batch_size : (start + length) * batch_size]
+                xb = feats[sel].reshape(length, batch_size, feat_dim)
+                yb = labs[sel].reshape((length, batch_size) + labs.shape[1:])
+                if jax.process_count() > 1:
+                    xb = jax.make_array_from_process_local_data(x_sharding, xb)
+                    yb = jax.make_array_from_process_local_data(y_sharding, yb)
+                else:
+                    xb = jax.device_put(xb, x_sharding)
+                    yb = jax.device_put(yb, y_sharding)
+                if length not in compiled:
+                    t0 = time.perf_counter()
+                    compiled[length] = jitted.lower(
+                        params, opt_state, xb, yb
                     ).compile()
                     self.compile_seconds_ += time.perf_counter() - t0
-                params, opt_state, loss_sum = state["compiled"](
-                    params, opt_state, xs_dev, ys_dev, perm
+                return compiled[length](params, opt_state, xb, yb)
+
+        def run_epoch(params, opt_state, seed, start_step=0, save_cb=None):
+            order = _order(seed)
+            loss_total = jnp.zeros((), jnp.float32)
+            done = start_step
+            while done < steps_per_epoch:
+                length = min(seg_cap, steps_per_epoch - done)
+                params, opt_state, loss_sum = run_segment(
+                    params, opt_state, order, done, length
                 )
-                return params, opt_state, loss_sum, steps_per_epoch
-
-            return run_epoch
-
-        x_sharding = NamedSharding(mesh, PartitionSpec(None, "data", None))
-        y_sharding = NamedSharding(
-            mesh, PartitionSpec(None, "data", *([None] * (labs.ndim - 1)))
-        )
-        jitted = jax.jit(epoch_body, donate_argnums=(0, 1) if donate else ())
-
-        def _stage_epoch(seed):
-            perm = _order(seed)
-            xb = feats[perm].reshape(steps_per_epoch, batch_size, feat_dim)
-            yb = labs[perm].reshape((steps_per_epoch, batch_size) + labs.shape[1:])
-            if jax.process_count() > 1:
-                return (
-                    jax.make_array_from_process_local_data(x_sharding, xb),
-                    jax.make_array_from_process_local_data(y_sharding, yb),
-                )
-            return (
-                jax.device_put(xb, x_sharding),
-                jax.device_put(yb, y_sharding),
-            )
-
-        def run_epoch(params, opt_state, seed):
-            xb, yb = _stage_epoch(seed)
-            if state["compiled"] is None:
-                t0 = time.perf_counter()
-                state["compiled"] = jitted.lower(params, opt_state, xb, yb).compile()
-                self.compile_seconds_ += time.perf_counter() - t0
-            params, opt_state, loss_sum = state["compiled"](
-                params, opt_state, xb, yb
-            )
-            return params, opt_state, loss_sum, steps_per_epoch
+                loss_total = loss_total + loss_sum
+                done += length
+                # the epoch-complete checkpoint is the outer loop's epoch_N
+                if save_cb is not None and done < steps_per_epoch:
+                    save_cb(params, opt_state, done)
+            return params, opt_state, loss_total, steps_per_epoch - start_step
 
         return run_epoch
 
@@ -775,29 +855,38 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
     # checkpointing (orbax; reference uses AIR Checkpoint dicts :243-250)
     # ------------------------------------------------------------------
 
-    def _save_checkpoint(self, params, epoch: int, opt_state) -> None:
+    def _ckpt_path(self, epoch: int, step: Optional[int] = None) -> str:
+        name = f"epoch_{epoch}" if step is None else f"epoch_{epoch}_step_{step}"
+        return os.path.join(os.path.abspath(self.checkpoint_dir), name)
+
+    def _save_checkpoint(
+        self, params, epoch: int, opt_state, step: Optional[int] = None
+    ) -> None:
         """Full training state (params + optimizer state) via orbax — exact
         step-level resume, strictly stronger than the reference's model-only
-        AIR checkpoints (torch/estimator.py:243-250)."""
+        AIR checkpoints (torch/estimator.py:243-250). ``step`` is the number
+        of completed steps WITHIN ``epoch`` (save_every_steps cadence);
+        ``step=None`` marks the epoch complete."""
         import jax
         import orbax.checkpoint as ocp
 
-        path = os.path.join(os.path.abspath(self.checkpoint_dir), f"epoch_{epoch}")
         state = {
             "params": jax.device_get(params),
             "opt_state": jax.device_get(opt_state),
         }
         with ocp.StandardCheckpointer() as ckptr:
-            ckptr.save(path, state, force=True)
+            ckptr.save(self._ckpt_path(epoch, step), state, force=True)
 
-    def _restore_checkpoint(self, epoch: int, target: Optional[dict] = None) -> dict:
+    def _restore_checkpoint(
+        self, epoch: int, target: Optional[dict] = None, step: Optional[int] = None
+    ) -> dict:
         """Checkpoint layout: {"params": <variables>, "opt_state": <optax>}.
         ``target`` (a concrete state template) restores optax namedtuple
         structure exactly; without it containers come back as plain pytrees
         (fine for params, which are dicts all the way down)."""
         import orbax.checkpoint as ocp
 
-        path = os.path.join(os.path.abspath(self.checkpoint_dir), f"epoch_{epoch}")
+        path = self._ckpt_path(epoch, step)
         with ocp.StandardCheckpointer() as ckptr:
             if target is not None:
                 return ckptr.restore(path, target)
@@ -822,10 +911,33 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         return self._history
 
 
-def latest_checkpoint_epoch(checkpoint_dir: Optional[str]) -> Optional[int]:
-    """Highest epoch with a committed checkpoint under checkpoint_dir
+def latest_checkpoint(checkpoint_dir: Optional[str]):
+    """Newest committed checkpoint as ``(epoch, step_or_None)`` — or None.
+    ``epoch_N`` (epoch complete) sorts after every ``epoch_N_step_K``
     (orbax renames the tmp dir only after a successful commit, so a bare
-    ``epoch_N`` directory is a complete checkpoint)."""
+    checkpoint directory is complete)."""
+    import re
+
+    if not checkpoint_dir:
+        return None
+    root = os.path.abspath(checkpoint_dir)
+    if not os.path.isdir(root):
+        return None
+    found = []
+    for name in os.listdir(root):
+        if not os.path.isdir(os.path.join(root, name)):
+            continue
+        m = re.fullmatch(r"epoch_(\d+)(?:_step_(\d+))?", name)
+        if m:
+            step = int(m.group(2)) if m.group(2) is not None else None
+            found.append((int(m.group(1)), step))
+    if not found:
+        return None
+    return max(found, key=lambda es: (es[0], float("inf") if es[1] is None else es[1]))
+
+
+def latest_checkpoint_epoch(checkpoint_dir: Optional[str]) -> Optional[int]:
+    """Highest epoch with a COMPLETE (end-of-epoch) checkpoint on disk."""
     import re
 
     if not checkpoint_dir:

@@ -59,12 +59,24 @@ class Planner:
 
         self.last_query_stats: dict = {}
         self._tls = threading.local()
+        # dynamic allocation: the session installs a hook called with each
+        # stage's width BEFORE dispatch (scale-up happens in time for the
+        # stage to use the new executors); _inflight gates scale-DOWN so an
+        # idle-timeout never kills executors under a running stage
+        self.scale_hook = None
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
 
     def __getstate__(self):
         # planners travel inside pickled sessions (Dataset._session → workers);
         # thread-local state is process-private and recreated on arrival
         state = dict(self.__dict__)
         state.pop("_tls", None)
+        # process-private: the allocation hook closes over the live session
+        # and the lock is unpicklable; a shipped planner runs without them
+        state.pop("scale_hook", None)
+        state.pop("_inflight_lock", None)
+        state["_inflight"] = 0
         return state
 
     def __setstate__(self, state):
@@ -72,6 +84,8 @@ class Planner:
 
         self.__dict__.update(state)
         self._tls = threading.local()
+        self.scale_hook = None
+        self._inflight_lock = threading.Lock()
 
     # ------------------------------------------------------------------
     # task submission
@@ -171,6 +185,14 @@ class Planner:
 
         stage_start = time.perf_counter()
         prefs: List[Optional[int]] = []
+        hook = self.scale_hook
+        if hook is not None:
+            with self._inflight_lock:
+                self._inflight += 1
+            try:
+                hook(len(specs))
+            except Exception:
+                pass  # allocation policy failures must never fail the query
         try:
             if not self.executors:
                 return [T.run_task(s) for s in specs]
@@ -181,6 +203,9 @@ class Planner:
             ]
             return self._gather(futures, specs)
         finally:
+            if hook is not None:
+                with self._inflight_lock:
+                    self._inflight -= 1
             log = getattr(self._tls, "stage_log", None)
             if log is not None:
                 log.append(

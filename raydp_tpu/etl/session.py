@@ -135,6 +135,13 @@ class EtlSession:
         actor_cpu = float(
             self.configs.get("etl.actor.resource.cpu", executor_cores)
         )
+        # etl.actor.env.FOO=bar → FOO=bar in every executor's environment
+        # (the reference's spark.executorEnv.* analog)
+        self._executor_env = {
+            key[len("etl.actor.env."):]: str(value)
+            for key, value in self.configs.items()
+            if key.startswith("etl.actor.env.")
+        }
         self.executors = []
         for i in range(num_executors):
             bundle = -1
@@ -158,6 +165,7 @@ class EtlSession:
                         bundle_index=bundle,
                         block=False,
                         light=self._light_actors,
+                        env=self._executor_env,
                     )
                     break
                 except ClusterError:
@@ -176,6 +184,40 @@ class EtlSession:
         self._planner = Planner(
             self.executors, default_parallelism=self.default_parallelism
         )
+
+        # dynamic allocation (reference: Spark's doRequestTotalExecutors /
+        # doKillExecutors hooks, RayCoarseGrainedSchedulerBackend.scala:
+        # 229-252 — there the ENGINE decides when to scale; here the policy
+        # watches stage width and idle time):
+        #   etl.dynamicAllocation.enabled        (default False)
+        #   etl.dynamicAllocation.maxExecutors   (default 4x initial)
+        #   etl.dynamicAllocation.minExecutors   (default initial count)
+        #   etl.dynamicAllocation.tasksPerSlot   (default 2)
+        #   etl.dynamicAllocation.idleTimeout    (seconds, default 10)
+        self._dyn_enabled = str(
+            self.configs.get("etl.dynamicAllocation.enabled", "false")
+        ).lower() in ("1", "true", "yes")
+        self._dyn_min = int(
+            self.configs.get("etl.dynamicAllocation.minExecutors", num_executors)
+        )
+        self._dyn_max = int(
+            self.configs.get(
+                "etl.dynamicAllocation.maxExecutors", max(num_executors * 4, 1)
+            )
+        )
+        self._dyn_tasks_per_slot = max(
+            1, int(self.configs.get("etl.dynamicAllocation.tasksPerSlot", 2))
+        )
+        self._dyn_idle_s = float(
+            self.configs.get("etl.dynamicAllocation.idleTimeout", 10.0)
+        )
+        self._last_stage_ts = time.monotonic()
+        self._dealloc_stop = threading.Event()
+        if self._dyn_enabled:
+            self._planner.scale_hook = self._on_stage_width
+            threading.Thread(
+                target=self._dealloc_loop, name="etl-dealloc", daemon=True
+            ).start()
 
     # ------------------------------------------------------------------
     # data sources
@@ -241,6 +283,48 @@ class EtlSession:
     # RayCoarseGrainedSchedulerBackend.scala:229-252)
     # ------------------------------------------------------------------
 
+    def __getstate__(self):
+        # sessions travel inside pickled Datasets (shards shipped to rank
+        # actors); thread objects are process-private, and a shipped session
+        # must not run an allocation policy of its own
+        state = dict(self.__dict__)
+        state.pop("_dealloc_stop", None)
+        state["_dyn_enabled"] = False
+        return state
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._dealloc_stop = threading.Event()
+
+    def _on_stage_width(self, num_tasks: int) -> None:
+        """Scale-up half of dynamic allocation: called by the planner before
+        dispatching a stage. A stage wider than tasksPerSlot × slots grows
+        the pool (bounded by maxExecutors) IN TIME for this stage's dispatch
+        to round-robin onto the new executors."""
+        self._last_stage_ts = time.monotonic()
+        slots = max(1, int(self.executor_cores))
+        desired = -(-num_tasks // (self._dyn_tasks_per_slot * slots))
+        desired = min(self._dyn_max, max(desired, len(self.executors)))
+        if desired > len(self.executors):
+            try:
+                self.request_total_executors(desired)
+            except ClusterError:
+                pass  # no capacity: the stage runs on the current pool
+
+    def _dealloc_loop(self) -> None:
+        """Scale-down half: after idleTimeout with no stage activity (and no
+        stage in flight), shrink back to minExecutors."""
+        while not self._dealloc_stop.wait(1.0):
+            if (
+                len(self.executors) > self._dyn_min
+                and self._planner._inflight == 0
+                and time.monotonic() - self._last_stage_ts > self._dyn_idle_s
+            ):
+                try:
+                    self.kill_executors(len(self.executors) - self._dyn_min)
+                except Exception:
+                    pass
+
     def request_total_executors(self, total: int) -> int:
         """Scale the executor pool up to ``total`` (no-op when already at or
         above). Returns the live executor count."""
@@ -274,6 +358,7 @@ class EtlSession:
                 max_restarts=3,
                 max_concurrency=max(2, self.executor_cores + 1),
                 light=self._light_actors,
+                env=getattr(self, "_executor_env", {}),
             )
             self.executors.append(handle)
         self._planner.executors = list(self.executors)
@@ -281,11 +366,27 @@ class EtlSession:
 
     def kill_executors(self, count: int = 1) -> int:
         """Scale down by killing ``count`` executors (intentional exit: no
-        restart). Blocks they produced are GC'd by ownership."""
+        restart). Their blocks are RE-OWNED to the session master first —
+        a graceful scale-down must not destroy still-referenced data (the
+        segments survive the process; only owner-death GC would unlink them).
+        The reference needs its external shuffle service for the same reason
+        (ray_cluster.py:126-134)."""
         from raydp_tpu.cluster.common import ActorState
 
         victims = self.executors[-count:] if count else []
         self.executors = self.executors[: len(self.executors) - len(victims)]
+        # sync the planner BEFORE any kill: a stage submitted during the
+        # (kill + DEAD-drain) window must not round-robin onto victims
+        self._planner.executors = list(self.executors)
+        for handle in victims:
+            try:
+                cluster.head_rpc(
+                    "object_reown_all",
+                    old_owner=handle._actor_id,
+                    new_owner=self.master._actor_id,
+                )
+            except Exception:
+                pass  # older head / racing shutdown: blocks fall back to GC
         for handle in victims:
             try:
                 handle.kill(no_restart=True)
@@ -318,6 +419,7 @@ class EtlSession:
         if self._stopped:
             return
         self._stopped = True
+        self._dealloc_stop.set()
         killed = list(self.executors)
         for handle in killed:
             try:

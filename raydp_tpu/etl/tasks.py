@@ -38,12 +38,14 @@ def write_table_block(
     table: pa.Table,
     owner: Optional[str] = None,
     max_records: int = DEFAULT_MAX_RECORDS_PER_BATCH,
+    storage: str = "auto",
 ) -> Tuple[store.ObjectRef, int]:
     """Serialize a Table as an Arrow IPC stream straight into a shared-memory
-    block (no staging copy on the happy path). Returns (ref, num_rows)."""
+    block (no staging copy on the happy path; spills to disk when shm is
+    full, or always with storage="disk"). Returns (ref, num_rows)."""
     table = table.combine_chunks()
     capacity = int(table.nbytes) + (1 << 16) + 512 * max(1, table.num_columns)
-    block = store.create_block(capacity)
+    block = store.create_block(capacity, storage=storage)
     try:
         sink = block.arrow_sink()
         with pa.ipc.new_stream(sink, table.schema) as writer:

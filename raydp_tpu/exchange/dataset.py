@@ -179,8 +179,7 @@ class Dataset:
                         refs.append(self.blocks[block_index])
                         counts.append(take_rows)
                     else:  # prefix slice materialized as a fresh block
-                        table = self.get_block(block_index).slice(0, take_rows)
-                        ref, cnt = T.write_table_block(table)
+                        ref, cnt = self._slice_block(block_index, take_rows)
                         refs.append(ref)
                         counts.append(cnt)
                 shards.append(
@@ -195,9 +194,36 @@ class Dataset:
             shards.append(Dataset(refs, self.schema, counts, session=self._session))
         return shards
 
+    def _slice_block(self, block_index: int, take_rows: int):
+        """Prefix-slice one block into a fresh block. With a live executor
+        pool the slice runs EXECUTOR-side (locality-dispatched read → trim →
+        write; the rows never touch the driver); otherwise driver-local."""
+        planner = getattr(self._session, "_planner", None) if self._session else None
+        if planner is not None and planner.executors:
+            node = lp.GlobalLimit(
+                lp.PartitionHead(
+                    lp.ArrowSource([self.blocks[block_index]], self.schema),
+                    take_rows,
+                ),
+                take_rows,
+            )
+            mat = planner.materialize(node)
+            blocks = [b for b in mat.blocks if b is not None]
+            if len(blocks) == 1:
+                return blocks[0], sum(mat.counts)
+            if blocks:  # unexpected multi-block output: don't leak it
+                from raydp_tpu.store import object_store as store
+
+                store.delete(blocks)
+        table = self.get_block(block_index).slice(0, take_rows)
+        return T.write_table_block(table)
+
     def _split_rebalanced(self, n: int) -> List["Dataset"]:
         """Fewer non-empty blocks than ranks: materialize once and re-slice
-        into n equal fresh blocks (wrapping to oversample the remainder)."""
+        into n equal fresh blocks (wrapping to oversample the remainder).
+        Driver-side by design — this path only triggers when the dataset has
+        fewer non-empty blocks than ranks, i.e. it is small (the 6-rows/
+        3-workers odd-shape case of reference test_torch_sequential.py)."""
         table = self.to_arrow()
         total = table.num_rows
         per = max(1, -(-total // n)) if total else 0
@@ -227,7 +253,11 @@ class Dataset:
         feature_dtype=np.float32,
         label_dtype=np.float32,
     ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-        """Materialize as a dense feature matrix [N, F] (+ label vector)."""
+        """Materialize as a dense feature matrix [N, F] (+ label vector).
+        Deliberately O(dataset) in THIS process's memory — it exists to stage
+        training data host-side once. For datasets that must not be
+        materialized whole, use ``iter_batches(streaming=True)`` or
+        ``JaxEstimator(streaming=True)`` (O(block) memory)."""
         return _table_to_numpy(
             self.to_arrow(), feature_columns, label_column,
             feature_dtype, label_dtype,
@@ -581,16 +611,37 @@ def dataset_from_parquet(paths) -> Dataset:
     return Dataset(blocks, schema, counts)
 
 
-def from_etl_recoverable(df, _use_owner: bool = False) -> Dataset:
+def from_etl_recoverable(
+    df, storage_level: str = "MEMORY_AND_DISK", _use_owner: bool = False
+) -> Dataset:
     """Fault-tolerant conversion: the dataset remembers the producing plan and
     re-materializes lost blocks through the (restartable) executor pool —
-    reference from_spark_recoverable semantics (dataset.py:189-209, §3.6)."""
+    reference from_spark_recoverable semantics (dataset.py:189-209, §3.6).
+
+    ``storage_level`` mirrors the reference's persist level
+    (ObjectStoreWriter.scala:229-231): "MEMORY_AND_DISK" (default) keeps
+    blocks in shm, auto-spilling to disk when shm fills; "DISK_ONLY"
+    migrates the blocks to the spill tier immediately (driver-node disk);
+    "MEMORY" is accepted for API parity and behaves as MEMORY_AND_DISK —
+    this store spills rather than dropping blocks (lineage recovery still
+    exists for lost blocks, so durability is strictly ≥ the reference's)."""
     import copy
 
+    if storage_level not in ("MEMORY", "MEMORY_AND_DISK", "DISK_ONLY"):
+        raise ValueError(f"unknown storage_level {storage_level!r}")
     plan_snapshot = copy.deepcopy(df._plan)
     mat = df.materialize()
     blocks = [b for b in mat.blocks if b is not None]
     counts = [c for b, c in zip(mat.blocks, mat.counts) if b is not None]
+    if storage_level == "DISK_ONLY":
+        from raydp_tpu.store import object_store as store
+
+        migrated = []
+        for ref in blocks:
+            data = bytes(store.get_buffer(ref).memoryview())
+            migrated.append(store.put(data, storage="disk"))
+        store.delete(blocks)
+        blocks = migrated
     ds = Dataset(
         blocks,
         mat.schema,

@@ -42,17 +42,26 @@ def elastic_fit(
     restarting the WHOLE gang from the latest committed checkpoint when any
     rank dies mid-fit.
 
-    ``fit_fn`` must write per-epoch checkpoints under ``checkpoint_dir``
-    (JaxEstimator(checkpoint_dir=...) does) and honor the
-    ``resume_from_epoch`` it is passed (None = fresh start). Returns the
-    per-rank results of the first fully-successful attempt.
+    ``fit_fn`` must write checkpoints under ``checkpoint_dir``
+    (JaxEstimator(checkpoint_dir=...) does) and honor the resume value it is
+    passed (None = fresh start; an int epoch, or an ``(epoch, step)`` tuple
+    when the newest committed checkpoint is a save_every_steps mid-epoch one
+    — JaxEstimator's ``resume_from_epoch`` accepts both, so a mid-epoch
+    death replays only the tail steps). Returns the per-rank results of the
+    first fully-successful attempt.
     """
-    from raydp_tpu.estimator.jax_estimator import latest_checkpoint_epoch
+    from raydp_tpu.estimator.jax_estimator import latest_checkpoint
     from raydp_tpu.spmd.job import create_spmd_job
 
     failures = 0
     while True:
-        resume = latest_checkpoint_epoch(checkpoint_dir)
+        latest = latest_checkpoint(checkpoint_dir)
+        if latest is None:
+            resume = None
+        elif latest[1] is None:
+            resume = latest[0]  # epoch complete
+        else:
+            resume = latest  # (epoch, step): resume mid-epoch
         job = create_spmd_job(
             f"{job_name}-a{failures}",
             world_size=world_size,

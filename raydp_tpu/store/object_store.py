@@ -15,6 +15,13 @@ of Ray's plasma, and ownership lives in the head process:
 
 Reads are zero-copy: the mapped segment is exposed to pyarrow as a foreign
 buffer feeding ``ipc.open_stream`` directly.
+
+Two storage tiers (parity: the reference's storage-level persist,
+ObjectStoreWriter.scala:229-231): /dev/shm segments (fast path) and a DISK
+spill tier (``<session>/spill/rtpu-*`` files, mmap'd on read). Writes spill
+automatically when shm is near-full (or the ``RAYDP_TPU_SHM_CAPACITY`` cap is
+exceeded) — a dataset larger than shm degrades to memory-and-disk instead of
+failing. ``storage="disk"`` forces the spill tier (DISK_ONLY semantics).
 """
 
 from __future__ import annotations
@@ -209,7 +216,7 @@ class WritableBlock:
             self._sealed = True
 
 
-def _register(ref: ObjectRef, owner: Optional[str]) -> None:
+def _register(ref: ObjectRef, owner: Optional[str], shm_name: Optional[str] = None) -> None:
     from raydp_tpu.cluster.worker import current_context
 
     if cluster_api.is_tcp_client():
@@ -225,7 +232,7 @@ def _register(ref: ObjectRef, owner: Optional[str]) -> None:
         "object_put",
         object_id=ref.object_id,
         owner=owner or current_owner(),
-        shm_name=ref.shm_name,
+        shm_name=shm_name or ref.shm_name,
         size=ref.size,
         node_id=ctx.node_id if ctx else "driver",
         shm_ns=shm_namespace(),
@@ -236,27 +243,163 @@ def new_object_id() -> str:
     return uuid.uuid4().hex[:16]
 
 
-def create_block(capacity: int) -> WritableBlock:
-    return WritableBlock(new_object_id(), capacity)
+# ---------------------------------------------------------------------------
+# disk spill tier
+# ---------------------------------------------------------------------------
+
+SHM_CAPACITY_ENV = "RAYDP_TPU_SHM_CAPACITY"
+_SHM_HEADROOM = 64 << 20  # never fill /dev/shm to the last byte
 
 
-def put(data, owner: Optional[str] = None) -> ObjectRef:
+def _spill_dir() -> str:
+    """This node's spill directory (under the session/local dir so cluster
+    teardown removes it with everything else)."""
+    base = os.environ.get("RAYDP_TPU_SESSION")
+    if not base:
+        try:
+            base = cluster_api.session_dir()
+        except Exception:
+            import tempfile
+
+            base = tempfile.gettempdir()
+    d = os.path.join(base, "spill")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _should_spill(capacity: int) -> bool:
+    """Spill when the write wouldn't fit shm: under an explicit test/ops cap
+    (total bytes of this framework's segments), or within the headroom of the
+    real tmpfs free space."""
+    cap = int(os.environ.get(SHM_CAPACITY_ENV, "0") or "0")
+    if cap:
+        try:
+            used = sum(
+                e.stat().st_size
+                for e in os.scandir("/dev/shm")
+                if e.name.startswith("rtpu-")
+            )
+        except OSError:
+            used = 0
+        return used + capacity > cap
+    try:
+        st = os.statvfs("/dev/shm")
+        return capacity > st.f_bavail * st.f_frsize - _SHM_HEADROOM
+    except OSError:
+        return False
+
+
+class _SpillBlock:
+    """WritableBlock's disk twin: a plain file in the spill dir, written
+    through the same mmap/arrow-sink interface, registered as ``file://``."""
+
+    def __init__(self, object_id: str, capacity: int):
+        import mmap as _mmap
+
+        self.object_id = object_id
+        self.capacity = capacity
+        self.path = os.path.join(_spill_dir(), f"rtpu-{object_id}")
+        self._file = open(self.path, "w+b")
+        os.ftruncate(self._file.fileno(), max(capacity, 1))
+        self._mmap = _mmap.mmap(self._file.fileno(), max(capacity, 1))
+        self._sealed = False
+
+    def arrow_sink(self):
+        import pyarrow as pa
+
+        return pa.FixedSizeBufferWriter(pa.py_buffer(self._mmap))
+
+    def _close_mapping(self) -> None:
+        try:
+            self._mmap.close()
+        except BufferError:
+            pass
+        self._file.close()
+
+    def seal(self, written: int, owner: Optional[str] = None) -> ObjectRef:
+        if self._sealed:
+            raise ClusterError("block already sealed")
+        if written > self.capacity:
+            raise ClusterError(f"wrote {written} past capacity {self.capacity}")
+        self._close_mapping()
+        os.truncate(self.path, written)
+        ref = ObjectRef(self.object_id, written)
+        try:
+            _register(ref, owner, shm_name=f"file://{self.path}")
+        except BaseException:
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            self._sealed = True
+            raise
+        self._sealed = True
+        return ref
+
+    def abort(self) -> None:
+        if not self._sealed:
+            self._close_mapping()
+            try:
+                os.unlink(self.path)
+            except OSError:
+                pass
+            self._sealed = True
+
+
+def create_block(capacity: int, storage: str = "auto"):
+    """A writable block in the requested tier: "auto" prefers shm and spills
+    to disk when shm is (nearly) full, "shm" is strict, "disk" forces the
+    spill tier (DISK_ONLY semantics)."""
+    object_id = new_object_id()
+    if storage == "disk":
+        return _SpillBlock(object_id, capacity)
+    if storage == "auto" and _should_spill(capacity):
+        return _SpillBlock(object_id, capacity)
+    try:
+        return WritableBlock(object_id, capacity)
+    except OSError:
+        if storage == "shm":
+            raise
+        return _SpillBlock(object_id, capacity)
+
+
+def put(data, owner: Optional[str] = None, storage: str = "auto") -> ObjectRef:
     """Store a materialized buffer (bytes / memoryview / arrow Buffer)."""
     import pyarrow as pa
 
     buf = data if isinstance(data, pa.Buffer) else pa.py_buffer(data)
-    lib = _load_native()
     object_id = new_object_id()
+    if storage == "disk" or (storage == "auto" and _should_spill(buf.size)):
+        return _put_spill(object_id, buf, owner)
+    lib = _load_native()
     ref = ObjectRef(object_id, buf.size)
     rc = lib.rtpu_shm_put(
         ref.shm_name.encode(), ctypes.c_void_p(buf.address), buf.size
     )
     if rc != 0:
-        raise OSError(f"shm put failed (errno={lib.rtpu_errno()})")
+        if storage == "shm":
+            raise OSError(f"shm put failed (errno={lib.rtpu_errno()})")
+        return _put_spill(object_id, buf, owner)
     try:
         _register(ref, owner)
     except BaseException:
         lib.rtpu_shm_unlink(ref.shm_name.encode())
+        raise
+    return ref
+
+
+def _put_spill(object_id: str, buf, owner: Optional[str]) -> ObjectRef:
+    path = os.path.join(_spill_dir(), f"rtpu-{object_id}")
+    with open(path, "wb") as f:
+        f.write(memoryview(buf))
+    ref = ObjectRef(object_id, buf.size)
+    try:
+        _register(ref, owner, shm_name=f"file://{path}")
+    except BaseException:
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
         raise
     return ref
 
@@ -278,6 +421,34 @@ class _FetchedBuffer:
 
     def memoryview(self) -> memoryview:
         return memoryview(self._data)
+
+
+class _FileBuffer:
+    """A spilled block mmap'd read-only from the local spill dir."""
+
+    def __init__(self, path: str, size: int):
+        import mmap as _mmap
+
+        self._file = open(path, "rb")
+        self.size = size
+        self._mmap = (
+            _mmap.mmap(self._file.fileno(), size, access=_mmap.ACCESS_READ)
+            if size
+            else None
+        )
+
+    def memoryview(self) -> memoryview:
+        if self._mmap is None:
+            return memoryview(b"")
+        return memoryview(self._mmap)
+
+    def __del__(self):
+        try:
+            if self._mmap is not None:
+                self._mmap.close()
+            self._file.close()
+        except Exception:
+            pass
 
 
 def get_buffer(ref: ObjectRef):
@@ -325,6 +496,16 @@ def get_buffer(ref: ObjectRef):
                 f"{len(data)} < {size}"
             )
         return _FetchedBuffer(data[:size])
+    if meta["shm_name"].startswith("file://"):
+        # spilled block on THIS node: mmap the file (still no payload copy)
+        path = meta["shm_name"][len("file://"):]
+        try:
+            return _FileBuffer(path, meta["size"])
+        except OSError as exc:
+            raise ClusterError(
+                f"object {ref.object_id} metadata exists but spill file is "
+                f"gone ({exc})"
+            )
     lib = _load_native()
     seg_size = ctypes.c_uint64()
     ptr = lib.rtpu_shm_map(meta["shm_name"].encode(), ctypes.byref(seg_size), 0)
@@ -353,7 +534,9 @@ def get_arrow_buffer(ref: ObjectRef):
     buf = get_buffer(ref)
     if buf.size == 0:
         return pa.py_buffer(b"")
-    if isinstance(buf, _FetchedBuffer):
+    if isinstance(buf, (_FetchedBuffer, _FileBuffer)):
+        # py_buffer wraps the existing memory (network bytes or spill mmap)
+        # without copying; the memoryview inside keeps the backing alive
         return pa.py_buffer(buf.memoryview())
     return pa.foreign_buffer(buf.ptr, buf.size, base=buf)
 

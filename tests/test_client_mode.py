@@ -156,3 +156,39 @@ def test_driver_inside_an_actor(running_cluster):
         assert h.run_etl.options(timeout=120).remote().result() == sum(range(50))
     finally:
         h.kill()
+
+
+CORE_MODULES = [
+    "tests/test_utils.py",
+    "tests/test_etl.py",
+    "tests/test_exchange.py",
+    "tests/test_jax_estimator.py",
+]
+
+
+@pytest.mark.slow
+def test_core_suite_through_attached_driver(running_cluster):
+    """Reference two-mode parity (conftest.py:45-52: every test runs locally
+    AND through ray:// client): the core ETL/exchange/estimator suite runs a
+    second time through a driver ATTACHED to this module's already-running
+    cluster — every init_etl inside lands on the shared cluster as a second
+    driver instead of auto-starting its own."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.pathsep.join([ROOT] + sys.path)
+    # attach, don't own: the child adopts the running session from env
+    env["RAYDP_TPU_SESSION"] = running_cluster["session_dir"]
+    env.pop("RAYDP_TPU_HEAD_ADDR", None)
+    env.pop("RAYDP_TPU_SHM_NS", None)
+    out = subprocess.run(
+        [
+            sys.executable, "-m", "pytest", *CORE_MODULES,
+            "-q", "-p", "no:cacheprovider",
+        ],
+        cwd=ROOT, env=env, capture_output=True, text=True, timeout=1500,
+    )
+    assert out.returncode == 0, (
+        f"client-mode suite failed:\n{out.stdout[-4000:]}\n{out.stderr[-2000:]}"
+    )
+    # the attached driver's shutdown() calls are detaches — the shared
+    # cluster must have survived the whole inner suite
+    assert cluster.head_rpc("ping") == "pong"

@@ -56,3 +56,23 @@ def test_spmd_job_example():
 def test_long_context_lm_example():
     stdout = _run_example("long_context_lm.py", timeout=420)
     assert "step 4" in stdout
+
+
+def test_data_process_example():
+    out = _run_example("data_process.py")
+    assert "total trips:" in out
+
+
+def test_torch_example():
+    out = _run_example("nyctaxi_torch.py")
+    assert "final train_loss" in out
+
+
+def test_tf_example():
+    out = _run_example("nyctaxi_tf.py")
+    assert "losses:" in out
+
+
+def test_xgboost_example():
+    out = _run_example("nyctaxi_xgboost.py", extra_env={"EXAMPLE_ROUNDS": "5"})
+    assert "backend:" in out and "prediction" in out

@@ -263,3 +263,86 @@ def test_stop_etl_after_conversion(session):
     assert len(history) == 2
     # session is stopped now; the module fixture teardown tolerates this
     assert raydp_tpu.etl.active_session() is None or raydp_tpu.etl.active_session()._stopped
+
+
+def _block_dataset(n=2048, seed=0):
+    """Driver-written Dataset — independent of the (possibly stopped) ETL
+    engine, so these tests can run after stop_etl_after_conversion ones."""
+    import pyarrow as pa
+
+    from raydp_tpu.etl.tasks import write_table_block
+    from raydp_tpu.exchange.dataset import Dataset
+
+    rng = np.random.default_rng(seed)
+    x = rng.random(n).astype(np.float32)
+    y = rng.random(n).astype(np.float32)
+    table = pa.table({"x": x, "y": y, "z": 3 * x + 4 * y + 5})
+    ref, cnt = write_table_block(table)
+    return Dataset([ref], table.schema, [cnt])
+
+
+def test_step_cadence_checkpoint_and_midepoch_resume(session):
+    """save_every_steps writes epoch_N_step_K mid-epoch, and resuming from
+    (epoch, step) replays EXACTLY the tail steps: the resumed run's final
+    params match an uninterrupted run bit-for-bit (deterministic batch order
+    per seed+epoch)."""
+    import jax
+
+    ckpt = tempfile.mkdtemp()
+    ds = _block_dataset()
+    # 2048 rows / batch 256 = 8 steps/epoch; checkpoints at steps 3 and 6
+    common = dict(
+        model=_mlp(), loss="mse", feature_columns=["x", "y"],
+        label_column="z", batch_size=256, num_epochs=1,
+        learning_rate=1e-2, seed=7, shuffle=True,
+    )
+    est_full = JaxEstimator(checkpoint_dir=ckpt, save_every_steps=3, **common)
+    est_full.fit(ds)
+    names = sorted(os.listdir(ckpt))
+    assert any(n == "epoch_0_step_3" for n in names), names
+    assert any(n == "epoch_0_step_6" for n in names), names
+    assert any(n == "epoch_0" for n in names), names
+
+    # resume from the step-3 checkpoint: replays steps 3..8 only
+    est_resumed = JaxEstimator(
+        checkpoint_dir=ckpt, resume_from_epoch=(0, 3), **common
+    )
+    est_resumed.fit(ds)
+    full = jax.tree.leaves(est_full.get_model().params)
+    resumed = jax.tree.leaves(est_resumed.get_model().params)
+    for a, b in zip(full, resumed):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-6)
+
+
+def test_retry_resumes_midepoch_from_step_checkpoint(session):
+    """A crash between step checkpoints retries from the newest
+    epoch_N_step_K — not from the last epoch boundary."""
+    ckpt = tempfile.mkdtemp()
+    ds = _block_dataset()
+    est = JaxEstimator(
+        model=_mlp(), loss="mse", feature_columns=["x", "y"],
+        label_column="z", batch_size=256, num_epochs=1,
+        learning_rate=1e-2, seed=7, checkpoint_dir=ckpt, save_every_steps=3,
+    )
+    calls = {"n": 0}
+    orig = est._save_checkpoint
+
+    def crash_after_step6(params, epoch, opt_state, step=None):
+        orig(params, epoch, opt_state, step=step)
+        if step == 6 and calls["n"] == 0:
+            calls["n"] += 1
+            raise RuntimeError("injected crash after step-6 checkpoint")
+
+    est._save_checkpoint = crash_after_step6
+    resumes = []
+    real_fit_once = est._fit_once
+
+    def spying_fit_once(train_ds, evaluate_ds):
+        resumes.append(est.resume_from_epoch)
+        return real_fit_once(train_ds, evaluate_ds)
+
+    est._fit_once = spying_fit_once
+    history = est.fit(ds, max_retries=2)
+    assert resumes[0] is None
+    assert resumes[1] == (0, 6), resumes  # resumed mid-epoch at step 6
+    assert len(history) == 1 and history[0]["epoch"] == 0

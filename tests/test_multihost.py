@@ -265,3 +265,45 @@ def test_tcp_requires_token_and_sane_shm_names(two_nodes):
         rpc(addr, ("block_fetch", {"shm_name": "../../etc/passwd"}), timeout=5)
     with pytest.raises(ClusterError, match="invalid shm segment"):
         rpc(addr, ("block_fetch", {"shm_name": "/rtpu-x/../../etc/passwd"}), timeout=5)
+
+
+class _SpillActor:
+    """Writes a table block to the DISK tier from whatever node it runs on."""
+
+    def write(self, table_bytes):
+        import pyarrow as pa
+
+        with pa.ipc.open_stream(table_bytes) as r:
+            table = r.read_all()
+        return T.write_table_block(table, storage="disk")
+
+
+def test_spilled_block_fetched_cross_node(two_nodes):
+    """A block spilled to DISK on the agent node is served to the head-node
+    driver through the agent's block server — the spill tier participates in
+    the cross-node data plane exactly like shm segments."""
+    import io
+
+    table = pa.table({"a": np.arange(512, dtype=np.int64)})
+    sink = io.BytesIO()
+    with pa.ipc.new_stream(sink, table.schema) as w:
+        w.write_table(table)
+
+    actor = cluster.spawn(
+        _SpillActor, name="mh-spill-writer", num_cpus=0.1,
+        resources={f"node:{two_nodes['agent_node'].node_ip}": 0.001},
+        light=True,
+    )
+    try:
+        ref, n = actor.write.remote(sink.getvalue()).result()
+        assert n == 512
+        meta = store._lookup(ref)
+        assert meta["shm_name"].startswith("file://")
+        assert meta["shm_ns"] == "tnb"  # lives on the agent node
+
+        fetched_before = store.stats["remote_fetches"]
+        out = T.read_table_block(ref)
+        assert out.equals(table)
+        assert store.stats["remote_fetches"] == fetched_before + 1
+    finally:
+        actor.kill()

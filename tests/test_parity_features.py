@@ -191,3 +191,53 @@ def test_fractional_executor_cpu():
         assert used == 1.0
     finally:
         raydp_tpu.stop_etl()
+
+
+def test_dynamic_allocation_grows_and_shrinks():
+    """Reference doRequestTotalExecutors/doKillExecutors
+    (RayCoarseGrainedSchedulerBackend.scala:229-252) — but policy-driven:
+    a wide stage grows the pool before dispatch; idleTimeout shrinks it back
+    to minExecutors."""
+    import time
+
+    session = raydp_tpu.init_etl(
+        "dynalloc",
+        num_executors=1,
+        executor_cores=1,
+        executor_memory="200M",
+        configs={
+            "etl.dynamicAllocation.enabled": "true",
+            "etl.dynamicAllocation.maxExecutors": "3",
+            "etl.dynamicAllocation.tasksPerSlot": "2",
+            "etl.dynamicAllocation.idleTimeout": "2",
+        },
+    )
+    try:
+        assert len(session.executors) == 1
+        rng = np.random.default_rng(0)
+        pdf = pd.DataFrame({"k": rng.integers(0, 7, 4000), "v": rng.random(4000)})
+        # 16 partitions / (2 tasks x 1 slot) => desired 8, capped at 3
+        df = session.from_pandas(pdf, num_partitions=16)
+        out = df.groupby("k").agg(sv=("sum", "v")).to_pandas()
+        assert abs(out["sv"].sum() - pdf["v"].sum()) < 1e-9
+        assert len(session.executors) == 3, "pool should have grown for the wide stage"
+
+        # blocks produced by the soon-to-die executors must survive the
+        # scale-down (graceful kill re-owns them to the session master)
+        from raydp_tpu.exchange import dataframe_to_dataset
+
+        ds = dataframe_to_dataset(df)
+
+        # idle: shrinks back to minExecutors
+        deadline = time.monotonic() + 20.0
+        while len(session.executors) > 1 and time.monotonic() < deadline:
+            time.sleep(0.5)
+        assert len(session.executors) == 1, "pool should shrink after idleTimeout"
+
+        survived = ds.to_pandas()
+        assert abs(survived["v"].sum() - pdf["v"].sum()) < 1e-9
+
+        # and the session still works at the shrunken size
+        assert session.range(100, num_partitions=4).count() == 100
+    finally:
+        raydp_tpu.stop_etl()
