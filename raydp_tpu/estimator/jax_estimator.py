@@ -557,7 +557,15 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     train_iter = PrefetchingDeviceIterator(host_iter, mesh)
                     loss_sum = jnp.zeros((), jnp.float32)
                     steps = epoch_start_step
+                    pending_save = None
                     for x, y in train_iter:
+                        if pending_save is not None:
+                            # DEFERRED one step: a save that would coincide
+                            # with the epoch's final step is dropped (the
+                            # epoch-complete epoch_N supersedes it) — so a
+                            # step checkpoint always has tail steps to replay
+                            save_mid_epoch(params, opt_state, epoch, pending_save)
+                            pending_save = None
                         if not first_step_done:
                             # the first call compiles (cold TPU compiles take
                             # tens of seconds); record it so callers can
@@ -575,12 +583,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             )
                         steps += 1
                         if save_steps and steps % save_steps == 0:
-                            # an epoch_N_step_S checkpoint where S happens to
-                            # be the final step is fine: the epoch-complete
-                            # epoch_N save below supersedes it, and a resume
-                            # from (N, S) runs zero tail steps and records no
-                            # bogus epoch (empty resumed epochs are skipped)
-                            save_mid_epoch(params, opt_state, epoch, steps)
+                            pending_save = steps
                         if (
                             self.sync_every_steps
                             and steps % self.sync_every_steps == 0
@@ -589,12 +592,13 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             jax.block_until_ready(loss_sum)
                     steps -= epoch_start_step
                 if steps == 0 and epoch_start_step > 0:
-                    # resumed exactly at this epoch's end (the newest
-                    # checkpoint was epoch_N_step_<last>): nothing trained —
+                    # resumed exactly at this epoch's end (a stale final-step
+                    # checkpoint from an older layout): nothing trained —
                     # recording a zero-loss epoch would poison downstream
                     # metrics; just finalize the epoch and move on
                     if self.checkpoint_dir:
                         self._save_checkpoint(params, epoch, opt_state)
+                        self._gc_step_checkpoints(epoch)
                     continue
                 # defer the host read: float(loss_sum) here would sync the
                 # pipeline every epoch; store the device scalar instead
@@ -613,6 +617,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 # only — a lone process-0 save deadlocks on those barriers
                 if self.checkpoint_dir:
                     self._save_checkpoint(params, epoch, opt_state)
+                    self._gc_step_checkpoints(epoch)
 
         for record in self._history:  # one sync at the end
             loss_sum, steps = record["train_loss"]
@@ -854,6 +859,27 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
     # ------------------------------------------------------------------
     # checkpointing (orbax; reference uses AIR Checkpoint dicts :243-250)
     # ------------------------------------------------------------------
+
+    def _gc_step_checkpoints(self, epoch: int) -> None:
+        """The epoch-complete checkpoint supersedes that epoch's mid-epoch
+        step checkpoints — drop them so save_every_steps doesn't accumulate
+        one full model copy per segment per epoch. Primary host only (the
+        save above already barriered, so epoch_N is committed everywhere)."""
+        import re
+        import shutil
+
+        import jax
+
+        if jax.process_index() != 0:
+            return
+        root = os.path.abspath(self.checkpoint_dir)
+        try:
+            names = os.listdir(root)
+        except OSError:
+            return
+        for name in names:
+            if re.fullmatch(rf"epoch_{epoch}_step_\d+", name):
+                shutil.rmtree(os.path.join(root, name), ignore_errors=True)
 
     def _ckpt_path(self, epoch: int, step: Optional[int] = None) -> str:
         name = f"epoch_{epoch}" if step is None else f"epoch_{epoch}_step_{step}"

@@ -421,6 +421,9 @@ class EtlSession:
         self._stopped = True
         self._dealloc_stop.set()
         killed = list(self.executors)
+        # stale handles must not look like a live pool (Dataset._slice_block
+        # and any late queries fall back to driver-local paths)
+        self._planner.executors = []
         for handle in killed:
             try:
                 handle.kill(no_restart=True)

@@ -210,6 +210,15 @@ class Dataset:
             mat = planner.materialize(node)
             blocks = [b for b in mat.blocks if b is not None]
             if len(blocks) == 1:
+                from raydp_tpu.store import object_store as store
+
+                # the slice must live and die with its SOURCE block, not with
+                # the executor that happened to produce it (executor-owned
+                # slices would be GC'd on scale-down/stop while the rest of
+                # the shard survives)
+                src_owner = store.owner_of(self.blocks[block_index])
+                if src_owner:
+                    store.transfer([blocks[0]], src_owner)
                 return blocks[0], sum(mat.counts)
             if blocks:  # unexpected multi-block output: don't leak it
                 from raydp_tpu.store import object_store as store

@@ -53,6 +53,12 @@ def _attend(q, k, v, *, impl: str, axis: str, causal: bool):
         )
     if impl == "ulysses":
         return ulysses_attention(q, k, v, axis_name=axis, causal=causal)
+    if impl == "ulysses_flash":
+        # all-to-all head parallelism with the fused flash kernel on the
+        # gathered local sequence
+        return ulysses_attention(
+            q, k, v, axis_name=axis, causal=causal, use_flash=True
+        )
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
@@ -143,10 +149,12 @@ def sequence_parallel_apply(model: TransformerLM, params, tokens, mesh):
         offset = lax.axis_index(axis) * tok.shape[1]
         return model.apply(p, tok, seq_offset=offset)
 
-    # ring_flash: the pallas interpreter can't reconcile invariant grid
+    # *_flash: the pallas interpreter can't reconcile invariant grid
     # slices with varying operands; numerics are test-validated against full
     # attention
-    check_vma = False if model.attn_impl == "ring_flash" else None
+    check_vma = (
+        False if model.attn_impl in ("ring_flash", "ulysses_flash") else None
+    )
     return shard_map_compat(
         body,
         mesh=mesh,

@@ -244,12 +244,15 @@ def ulysses_attention(
     v: jnp.ndarray,
     axis_name: str,
     causal: bool = False,
+    use_flash: bool = False,
 ) -> jnp.ndarray:
     """DeepSpeed-Ulysses-style sequence parallelism: all-to-all swaps the
     sharded dim from sequence to heads, attention runs locally on full
     sequences for H/N heads, then all-to-all swaps back. Cheaper than a ring
     when H divides the axis and the full sequence fits one device's memory
-    budget; call inside shard_map. Per-device shapes: [B, H, T_local, D]."""
+    budget; call inside shard_map. Per-device shapes: [B, H, T_local, D].
+    ``use_flash``: compute the local attention with the fused pallas flash
+    kernel (O(T) memory for the gathered sequence) instead of the einsum."""
     n = lax.axis_size(axis_name)
     b, h, t, d = q.shape
     if h % n:
@@ -264,6 +267,13 @@ def ulysses_attention(
         return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
 
     qg, kg, vg = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    if use_flash:
+        from raydp_tpu.ops.flash_attention import flash_attention
+
+        tg = qg.shape[2]
+        block = next(b for b in (128, 64, 32, 16, 8, 4, 2, 1) if tg % b == 0)
+        og = flash_attention(qg, kg, vg, causal, block, block)
+        return heads_to_seq(og)
     tg = qg.shape[2]
     scale = d**-0.5
     scores = jnp.einsum("bhqd,bhkd->bhqk", qg, kg) * scale

@@ -289,6 +289,7 @@ def test_step_cadence_checkpoint_and_midepoch_resume(session):
     import jax
 
     ckpt = tempfile.mkdtemp()
+    ckpt_partial = tempfile.mkdtemp()
     ds = _block_dataset()
     # 2048 rows / batch 256 = 8 steps/epoch; checkpoints at steps 3 and 6
     common = dict(
@@ -299,13 +300,29 @@ def test_step_cadence_checkpoint_and_midepoch_resume(session):
     est_full = JaxEstimator(checkpoint_dir=ckpt, save_every_steps=3, **common)
     est_full.fit(ds)
     names = sorted(os.listdir(ckpt))
-    assert any(n == "epoch_0_step_3" for n in names), names
-    assert any(n == "epoch_0_step_6" for n in names), names
-    assert any(n == "epoch_0" for n in names), names
+    # the completed epoch GC'd its step checkpoints; epoch_0 supersedes them
+    assert names == ["epoch_0"], names
 
-    # resume from the step-3 checkpoint: replays steps 3..8 only
+    # a CRASHED run leaves its mid-epoch step checkpoints behind
+    est_partial = JaxEstimator(
+        checkpoint_dir=ckpt_partial, save_every_steps=3, **common
+    )
+    orig = est_partial._save_checkpoint
+
+    def crash_after_step3(params, epoch, opt_state, step=None):
+        orig(params, epoch, opt_state, step=step)
+        if step == 3:
+            raise RuntimeError("injected crash after step-3 checkpoint")
+
+    est_partial._save_checkpoint = crash_after_step3
+    with pytest.raises(RuntimeError):
+        est_partial.fit(ds)
+    assert "epoch_0_step_3" in os.listdir(ckpt_partial)
+
+    # resume from the step-3 checkpoint: replays steps 3..8 only and lands
+    # on EXACTLY the uninterrupted run's params (same seed → same order)
     est_resumed = JaxEstimator(
-        checkpoint_dir=ckpt, resume_from_epoch=(0, 3), **common
+        checkpoint_dir=ckpt_partial, resume_from_epoch=(0, 3), **common
     )
     est_resumed.fit(ds)
     full = jax.tree.leaves(est_full.get_model().params)
