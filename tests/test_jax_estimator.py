@@ -363,3 +363,54 @@ def test_retry_resumes_midepoch_from_step_checkpoint(session):
     assert resumes[0] is None
     assert resumes[1] == (0, 6), resumes  # resumed mid-epoch at step 6
     assert len(history) == 1 and history[0]["epoch"] == 0
+
+
+def test_stream_segments_match_per_step(session):
+    """Segment-scanned streaming (stream_scan_steps) trains identically to
+    the per-step loop — with far fewer dispatches — including when step
+    checkpoints snap the segment length to the save cadence."""
+    import jax
+
+    ds = _block_dataset(n=3000, seed=5)
+    common = dict(
+        model=_mlp(), loss="mse", feature_columns=["x", "y"],
+        label_column="z", batch_size=64, num_epochs=2,
+        learning_rate=1e-2, seed=1, streaming=True,
+    )
+    ref = JaxEstimator(stream_scan_steps=0, **common)
+    ref.fit(ds)
+    seg = JaxEstimator(stream_scan_steps=7, **common)
+    seg.fit(ds)
+    for a, b in zip(
+        jax.tree.leaves(ref.get_model().params),
+        jax.tree.leaves(seg.get_model().params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+    # step checkpoints along segment boundaries, resumable mid-epoch
+    ckpt = tempfile.mkdtemp()
+    partial_est = JaxEstimator(
+        stream_scan_steps=16, save_every_steps=10, checkpoint_dir=ckpt,
+        **common,
+    )
+    orig = partial_est._save_checkpoint
+
+    def crash_at_20(params, epoch, opt_state, step=None):
+        orig(params, epoch, opt_state, step=step)
+        if epoch == 1 and step == 20:
+            raise RuntimeError("boom")
+
+    partial_est._save_checkpoint = crash_at_20
+    with pytest.raises(RuntimeError):
+        partial_est.fit(ds)
+    assert "epoch_1_step_20" in os.listdir(ckpt)
+    resumed = JaxEstimator(
+        stream_scan_steps=16, checkpoint_dir=ckpt,
+        resume_from_epoch=(1, 20), **common,
+    )
+    resumed.fit(ds)
+    for a, b in zip(
+        jax.tree.leaves(ref.get_model().params),
+        jax.tree.leaves(resumed.get_model().params),
+    ):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
