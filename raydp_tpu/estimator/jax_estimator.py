@@ -101,28 +101,11 @@ def partial_jit(donate_argnums=()):
 
 
 def _put_stacked_batch(mesh, arr):
-    """Place a stacked [S, B, ...] host batch onto the mesh with the batch
-    dim sharded over "data" — the one upload recipe shared by the scan and
-    stream runners. Single-device default-placement stays UNCOMMITTED
-    (committed arrays force a ~10ms/call executor path on some PJRT
-    plugins; see device_put_batch)."""
-    import jax
-    import jax.numpy as jnp
-    from jax.sharding import NamedSharding, PartitionSpec
+    """Upload recipe shared by the scan and stream runners — delegates to
+    the exchange layer's one implementation of the placement rules."""
+    from raydp_tpu.exchange.jax_io import device_put_stacked
 
-    from raydp_tpu.exchange.jax_io import _mesh_device_count, _mesh_single_device
-
-    if jax.process_count() == 1 and _mesh_device_count(mesh) <= 1:
-        device = _mesh_single_device(mesh)
-        if device == jax.devices()[0]:
-            return jnp.asarray(arr)
-        return jax.device_put(arr, device)
-    sharding = NamedSharding(
-        mesh, PartitionSpec(None, "data", *([None] * (arr.ndim - 2)))
-    )
-    if jax.process_count() > 1:
-        return jax.make_array_from_process_local_data(sharding, arr)
-    return jax.device_put(arr, sharding)
+    return device_put_stacked(arr, mesh)
 
 
 def _scan_over_batches(step_impl, params, opt_state, xb, yb):
@@ -203,6 +186,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         scan_memory_limit: int = 1 << 30,
         save_every_steps: Optional[int] = None,
         stream_scan_steps: int = 32,
+        keep_checkpoints: Optional[int] = None,
     ):
         self._model_arg = model
         self._optimizer_arg = optimizer
@@ -253,6 +237,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         # with ~N× fewer dispatches than a per-step loop. 0 restores the
         # per-step path.
         self.stream_scan_steps = stream_scan_steps
+        # retention: keep only the newest N epoch checkpoints (each is a full
+        # params+opt_state copy). None keeps everything.
+        self.keep_checkpoints = keep_checkpoints
 
         self._module = None
         self._params = None
@@ -731,8 +718,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         if save_every is not None:
             # the segment length must DIVIDE the save cadence so checkpoints
             # land exactly on multiples of save_every_steps (save=100,
-            # seg=32 → seg becomes 25: boundaries 25/50/75/100)
-            seg = save_every // max(1, -(-save_every // seg))
+            # seg=32 → seg becomes 25: boundaries 25/50/75/100). Largest
+            # divisor ≤ stream_scan_steps; seg=1 always qualifies.
+            seg = min(seg, save_every)
+            while save_every % seg:
+                seg -= 1
         compiled: Dict[int, Any] = {}
 
         def epoch_body(params, opt_state, xb, yb):
@@ -1020,8 +1010,10 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
     def _gc_step_checkpoints(self, epoch: int) -> None:
         """The epoch-complete checkpoint supersedes that epoch's mid-epoch
         step checkpoints — drop them so save_every_steps doesn't accumulate
-        one full model copy per segment per epoch. Primary host only (the
-        save above already barriered, so epoch_N is committed everywhere)."""
+        one full model copy per segment per epoch. With ``keep_checkpoints``
+        set, epoch checkpoints older than the newest N go too. Primary host
+        only (the save above already barriered, so epoch_N is committed
+        everywhere)."""
         import re
         import shutil
 
@@ -1034,9 +1026,16 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             names = os.listdir(root)
         except OSError:
             return
+        keep_from = (
+            epoch - self.keep_checkpoints + 1 if self.keep_checkpoints else None
+        )
         for name in names:
             if re.fullmatch(rf"epoch_{epoch}_step_\d+", name):
                 shutil.rmtree(os.path.join(root, name), ignore_errors=True)
+            elif keep_from is not None:
+                m = re.fullmatch(r"epoch_(\d+)", name)
+                if m and int(m.group(1)) < keep_from:
+                    shutil.rmtree(os.path.join(root, name), ignore_errors=True)
 
     def _ckpt_path(self, epoch: int, step: Optional[int] = None) -> str:
         name = f"epoch_{epoch}" if step is None else f"epoch_{epoch}_step_{step}"

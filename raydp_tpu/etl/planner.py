@@ -377,10 +377,14 @@ class Planner:
                 raise TypeError(type(n).__name__)
         return out
 
-    def materialize(self, node: lp.PlanNode) -> Materialized:
-        """Execute to object-store blocks (one per partition)."""
+    def materialize(self, node: lp.PlanNode, storage: str = "auto") -> Materialized:
+        """Execute to object-store blocks (one per partition). ``storage``
+        selects the block tier ("disk" = persist to each executor node's
+        spill dir — DISK_ONLY storage-level semantics, no driver round-trip)."""
         results = self._instrumented(
-            lambda: self._execute(node, T.OutputSpec("block", owner=self.owner))
+            lambda: self._execute(
+                node, T.OutputSpec("block", owner=self.owner, storage=storage)
+            )
         )
         schema = self.infer_schema(node)
         blocks = [r.blocks[0] if r.blocks else None for r in results]

@@ -126,6 +126,7 @@ class OutputSpec:
     path: Optional[str] = None  # parquet output directory
     owner: Optional[str] = None  # ownership target for produced blocks
     max_records: int = DEFAULT_MAX_RECORDS_PER_BATCH
+    storage: str = "auto"  # block tier: "auto" | "shm" | "disk" (spill)
 
 
 @dataclass
@@ -587,7 +588,10 @@ def _emit(table: pa.Table, spec: TaskSpec) -> TaskResult:
     if out.kind == "inline":
         return TaskResult(inline_ipc=table_to_ipc_bytes(table), count=table.num_rows)
     if out.kind == "block":
-        ref, n = write_table_block(table, owner=out.owner, max_records=out.max_records)
+        ref, n = write_table_block(
+            table, owner=out.owner, max_records=out.max_records,
+            storage=out.storage,
+        )
         return TaskResult(blocks=[ref], num_rows=[n])
     if out.kind == "parquet":
         import pyarrow.parquet as pq

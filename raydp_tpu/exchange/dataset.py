@@ -629,20 +629,35 @@ def from_etl_recoverable(
 
     ``storage_level`` mirrors the reference's persist level
     (ObjectStoreWriter.scala:229-231): "MEMORY_AND_DISK" (default) keeps
-    blocks in shm, auto-spilling to disk when shm fills; "DISK_ONLY"
-    migrates the blocks to the spill tier immediately (driver-node disk);
-    "MEMORY" is accepted for API parity and behaves as MEMORY_AND_DISK —
-    this store spills rather than dropping blocks (lineage recovery still
-    exists for lost blocks, so durability is strictly ≥ the reference's)."""
+    blocks in shm, auto-spilling to disk when shm fills; "DISK_ONLY" writes
+    the blocks to the DISK spill tier — EXECUTOR-side when a live pool
+    exists (each node's own spill dir; the bytes never cross to the driver,
+    and without ``_use_owner`` they stay executor-owned, relying on lineage
+    recovery past executor death), else migrated through the driver to its
+    spill dir; "MEMORY" is accepted for API parity and behaves as
+    MEMORY_AND_DISK — this store spills rather than dropping blocks
+    (lineage recovery still exists for lost blocks, so durability is
+    strictly ≥ the reference's)."""
     import copy
 
     if storage_level not in ("MEMORY", "MEMORY_AND_DISK", "DISK_ONLY"):
         raise ValueError(f"unknown storage_level {storage_level!r}")
     plan_snapshot = copy.deepcopy(df._plan)
-    mat = df.materialize()
+    planner = getattr(df._session, "_planner", None)
+    executor_side = (
+        storage_level == "DISK_ONLY"
+        and planner is not None
+        and bool(planner.executors)
+    )
+    mat = (
+        planner.materialize(df._plan, storage="disk")
+        if executor_side
+        else df.materialize()
+    )
     blocks = [b for b in mat.blocks if b is not None]
     counts = [c for b, c in zip(mat.blocks, mat.counts) if b is not None]
-    if storage_level == "DISK_ONLY":
+    if storage_level == "DISK_ONLY" and not executor_side:
+        # no live executor pool: migrate through the driver to its spill dir
         from raydp_tpu.store import object_store as store
 
         migrated = []

@@ -414,3 +414,16 @@ def test_stream_segments_match_per_step(session):
         jax.tree.leaves(resumed.get_model().params),
     ):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-5)
+
+
+def test_keep_checkpoints_retention(session):
+    ds = _block_dataset(n=1024, seed=9)
+    ckpt = tempfile.mkdtemp()
+    est = JaxEstimator(
+        model=_mlp(), loss="mse", feature_columns=["x", "y"],
+        label_column="z", batch_size=128, num_epochs=5,
+        checkpoint_dir=ckpt, keep_checkpoints=2, seed=0,
+    )
+    est.fit(ds)
+    names = sorted(os.listdir(ckpt))
+    assert names == ["epoch_3", "epoch_4"], names

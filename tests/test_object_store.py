@@ -239,3 +239,34 @@ def test_recoverable_disk_only_storage_level(runtime):
             from_etl_recoverable(df, storage_level="NOPE")
     finally:
         raydp_tpu.stop_etl()
+
+
+def test_recoverable_disk_only_executor_side(runtime):
+    """With a live executor pool, DISK_ONLY persists executor-side (blocks
+    written straight to the executors' spill dirs — owned by executors until
+    transferred), not through the driver."""
+    import numpy as np
+    import pandas as pd
+
+    import raydp_tpu
+    from raydp_tpu.exchange import from_etl_recoverable
+
+    s = raydp_tpu.init_etl(
+        "test-disk-exec", num_executors=2, executor_cores=1,
+        executor_memory="300M",
+    )
+    try:
+        pdf = pd.DataFrame({"a": np.arange(4000, dtype=np.float64)})
+        df = s.from_pandas(pdf, num_partitions=4)
+        ds = from_etl_recoverable(df, storage_level="DISK_ONLY", _use_owner=True)
+        metas = [store.object_store._lookup(r) for r in ds.blocks]
+        assert all(m["shm_name"].startswith("file://") for m in metas)
+        # ownership transferred to the session master (one long-lived owner)
+        master_id = cluster.get_actor("test-disk-exec_ETL_MASTER")._actor_id
+        owners = {store.owner_of(r) for r in ds.blocks}
+        assert owners == {master_id}, owners
+        assert float(ds.to_pandas()["a"].sum()) == float(pdf["a"].sum())
+    finally:
+        raydp_tpu.stop_etl(cleanup_data=False, del_obj_holder=False)
+    # blocks survive the engine stop (ownership transferred to the master)
+    assert float(ds.to_pandas()["a"].sum()) == float(pdf["a"].sum())
