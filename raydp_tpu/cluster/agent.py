@@ -183,8 +183,22 @@ class NodeAgent:
         watch head liveness: an agent must not outlive its cluster."""
         last_head_ok = time.monotonic()
         last_ping = 0.0
+        last_zygote_check = 0.0
         while not self.stopping:
             time.sleep(0.05)
+            # zygote liveness (same role as the head's _ensure_zygote, for
+            # THIS node): a dead fork template silently degrades every light
+            # spawn/restart here to ~450ms cold starts
+            now = time.monotonic()
+            if now - last_zygote_check > 2.0:
+                last_zygote_check = now
+                from raydp_tpu.cluster.common import start_zygote, zygote_alive
+
+                if not zygote_alive(self.local_dir):
+                    try:
+                        start_zygote(self.local_dir)
+                    except Exception:
+                        pass  # cold-start fallback keeps working
             dead = []
             with self.lock:
                 for actor_id, child in list(self.children.items()):

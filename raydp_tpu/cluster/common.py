@@ -322,6 +322,12 @@ class ZygoteProc:
             return None
 
 
+# the zygote processes THIS process started, keyed by run_dir — kept so
+# liveness checks can poll() (and thereby reap) a dead child: a bare pid
+# probe sees the unreaped zombie as alive forever
+_zygote_procs: Dict[str, Any] = {}
+
+
 def start_zygote(run_dir: str) -> None:
     """Start the pre-warmed fork template for this node (idempotent per
     marker file). Called at head/agent boot so the warm-up overlaps other
@@ -341,9 +347,27 @@ def start_zygote(run_dir: str) -> None:
             env=dict(os.environ),
             start_new_session=True,
         )
+    _zygote_procs[run_dir] = proc
     with open(marker + ".tmp", "w") as f:
         f.write(str(proc.pid))
     os.replace(marker + ".tmp", marker)
+
+
+def zygote_alive(run_dir: str) -> bool:
+    """Is this node's zygote running? Polls (reaps) our own child; falls
+    back to a pid probe for a zygote another process started."""
+    proc = _zygote_procs.get(run_dir)
+    if proc is not None:
+        return proc.poll() is None
+    from raydp_tpu.cluster.zygote import zygote_marker_path
+
+    try:
+        with open(zygote_marker_path(run_dir)) as f:
+            pid = int(f.read().strip())
+        os.kill(pid, 0)
+        return True
+    except (OSError, ValueError):
+        return False
 
 
 def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log_base: str):
@@ -353,13 +377,7 @@ def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log
     from raydp_tpu.cluster.zygote import zygote_marker_path, zygote_sock_path
 
     marker = zygote_marker_path(run_dir)
-    if not os.path.exists(marker):
-        return None
-    try:
-        with open(marker) as f:
-            zygote_pid = int(f.read().strip())
-        os.kill(zygote_pid, 0)
-    except (OSError, ValueError):
+    if not os.path.exists(marker) or not zygote_alive(run_dir):
         return None
     sock_path = zygote_sock_path(run_dir)
     # the zygote may still be warming its imports; wait for the socket (its
@@ -375,9 +393,7 @@ def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log
             sock.close()
             if time.monotonic() > deadline:
                 return None
-            try:
-                os.kill(zygote_pid, 0)
-            except OSError:
+            if not zygote_alive(run_dir):
                 return None  # died while warming
             time.sleep(0.02)
     try:

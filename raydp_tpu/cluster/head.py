@@ -830,6 +830,7 @@ class Head:
         return True
 
     def monitor_loop(self) -> None:
+        last_zygote_check = 0.0
         while not self.shutting_down:
             time.sleep(0.05)
             with self.lock:
@@ -841,10 +842,27 @@ class Head:
                         continue
                     if actor.proc is not None and actor.proc.poll() is not None:
                         self._on_actor_death(actor)
+            # zygote liveness: spawns silently degrade to ~450ms cold starts
+            # if the fork template dies — restart it (cheap pid probe, 2s
+            # cadence; launch_worker's cold fallback covers the gap)
+            now = time.monotonic()
+            if now - last_zygote_check > 2.0:
+                last_zygote_check = now
+                self._ensure_zygote()
             # driver liveness: tear everything down if the driver is gone
             if self.driver_pid and not _pid_alive(self.driver_pid):
                 self.handle_shutdown()
                 os._exit(0)
+
+    def _ensure_zygote(self) -> None:
+        from raydp_tpu.cluster.common import start_zygote, zygote_alive
+
+        if zygote_alive(self.session_dir):
+            return
+        try:
+            start_zygote(self.session_dir)
+        except Exception:
+            pass  # spawns keep falling back to cold subprocess starts
 
     def agent_watchdog_loop(self) -> None:
         """Agent liveness: agents watch the head, the head watches agents.

@@ -245,3 +245,51 @@ def test_multinode_spread_and_node_kill(runtime):
         cluster.remove_node(n4)
     finally:
         cluster.remove_node(n1)
+
+
+def test_zygote_restarts_after_death(runtime):
+    """The head's monitor restarts a dead zygote (reaping the zombie — a
+    bare pid probe would see it alive forever) and spawns stay fork-fast."""
+    import signal
+    import socket
+    import time
+
+    from raydp_tpu.cluster.zygote import zygote_marker_path, zygote_sock_path
+
+    sd = cluster.session_dir()
+    with open(zygote_marker_path(sd)) as f:
+        pid1 = int(f.read())
+    os.kill(pid1, signal.SIGKILL)
+    deadline = time.monotonic() + 10.0
+    pid2 = pid1
+    while pid2 == pid1 and time.monotonic() < deadline:
+        time.sleep(0.3)
+        with open(zygote_marker_path(sd)) as f:
+            pid2 = int(f.read())
+    assert pid2 != pid1, "watchdog did not restart the zygote"
+
+    # wait out the new zygote's import warm-up (socket binds after it) so
+    # the timed spawn below measures only the fork path, not warm-up
+    deadline = time.monotonic() + 30.0
+    while time.monotonic() < deadline:
+        s = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        try:
+            s.connect(zygote_sock_path(sd))
+            s.close()
+            break
+        except OSError:
+            s.close()
+            time.sleep(0.1)
+
+    class Pinger:
+        def ping(self):
+            return 42
+
+    t0 = time.monotonic()
+    h = cluster.spawn(Pinger, name="zygote-restart-probe", light=True)
+    spawn_s = time.monotonic() - t0
+    try:
+        assert h.ping.remote().result() == 42
+        assert spawn_s < 1.0, f"spawn took {spawn_s:.2f}s — cold fallback?"
+    finally:
+        h.kill()
