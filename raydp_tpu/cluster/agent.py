@@ -68,6 +68,12 @@ class NodeAgent:
         self.local_dir = local_dir
         os.makedirs(local_dir, exist_ok=True)
         self.children: Dict[str, _ChildProc] = {}
+        # highest incarnation ever spawned here, per actor id. The fence
+        # must survive the children-table entry (monitor_loop deletes it
+        # after a death report) or a delayed stale spawn arriving AFTER the
+        # newer worker died would resurrect a fenced-out incarnation as a
+        # leaked live process nothing will ever kill.
+        self.incarnation_floor: Dict[str, int] = {}
         self.lock = threading.RLock()
         self.stopping = False
         self.addr: Optional[str] = None
@@ -87,6 +93,14 @@ class NodeAgent:
         """Fork the worker on THIS host. The spec arrives in the RPC and is
         written to the agent's local dir — no shared filesystem with the head
         is assumed (the head-local path writes it to the session dir)."""
+        # Fence BEFORE forking: spawn RPCs land on server threads, so a
+        # delayed stale delivery (the fenced-out incarnation whose reply the
+        # head lost) can arrive AFTER the newer respawn already runs here.
+        # Ordering, not inequality, decides who is stale — a stale spawn must
+        # never kill or displace the newer healthy worker.
+        with self.lock:
+            if self.incarnation_floor.get(spec.actor_id, -1) >= incarnation:
+                return False  # newer (or duplicate) spawn already owned the id
         spec_path = os.path.join(self.local_dir, f"a-{spec.actor_id}.spec")
         with open(spec_path + ".tmp", "wb") as f:
             cloudpickle.dump(spec, f)
@@ -109,22 +123,35 @@ class NodeAgent:
 
         proc = launch_worker(spec, incarnation, self.local_dir, env)
         with self.lock:
-            # a previous incarnation still running here is by definition
-            # stale once the head spawns a newer one (fence-out after a lost
-            # spawn reply): kill it before its children-table entry — and
-            # with it the only pid we hold — is overwritten, or it would
-            # leak as a live process for the life of the node
             old = self.children.get(spec.actor_id)
-            if (
-                old is not None
-                and old.incarnation != incarnation
-                and old.proc.poll() is None
-            ):
+            if self.incarnation_floor.get(spec.actor_id, -1) >= incarnation:
+                # a newer spawn landed while we were forking: OURS is the
+                # stale one — reap it and leave the newer worker untouched.
+                # The just-forked child may not have setsid'd yet (no
+                # process group of its own), so fall back to a direct kill
+                # rather than letting it survive untracked.
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except ProcessLookupError:
+                    try:
+                        os.kill(proc.pid, signal.SIGKILL)
+                    except (ProcessLookupError, PermissionError):
+                        pass
+                except PermissionError:
+                    pass
+                return False
+            # an OLDER incarnation still running here is by definition stale
+            # once the head spawns a newer one: kill it before its
+            # children-table entry — and with it the only pid we hold — is
+            # overwritten, or it would leak as a live process for the life
+            # of the node
+            if old is not None and old.proc.poll() is None:
                 try:
                     os.killpg(old.proc.pid, signal.SIGKILL)
                 except (ProcessLookupError, PermissionError):
                     pass
             self.children[spec.actor_id] = _ChildProc(proc, incarnation)
+            self.incarnation_floor[spec.actor_id] = incarnation
             self.stats["spawned"] += 1
         return True
 

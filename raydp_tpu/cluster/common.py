@@ -302,24 +302,37 @@ def unlink_block(shm_name: str) -> None:
 
 class ZygoteProc:
     """Popen-shaped handle for a zygote-forked worker. The child's true
-    parent (the zygote) reaps it; monitors here can only pid-probe — which is
-    exactly the two operations the head/agent monitors use (.poll, .pid)."""
+    parent (the zygote) reaps it and records the exit status in an
+    ``<log_base>.exit`` marker; monitors here read the marker first, then
+    fall back to a pid probe — a raw probe alone would report "alive"
+    forever after pid reuse and could never recover the exit code."""
 
-    def __init__(self, pid: int):
+    def __init__(self, pid: int, log_base: str = ""):
         self.pid = pid
+        self._log_base = log_base
         self._rc: Optional[int] = None
 
     def poll(self) -> Optional[int]:
         if self._rc is not None:
             return self._rc
+        if self._log_base:
+            try:
+                with open(self._log_base + ".exit") as f:
+                    self._rc = int(f.read().strip() or 0)
+                return self._rc
+            except (OSError, ValueError):
+                pass  # no marker yet: the child may still be running
         try:
             os.kill(self.pid, 0)
             return None
         except ProcessLookupError:
-            self._rc = 0  # reaped by the zygote; exit code unknown
+            self._rc = 0  # gone before the marker landed; code unknown
             return self._rc
         except PermissionError:  # pragma: no cover - pid reused by other uid
-            return None
+            # the pid now belongs to someone else's process, so OUR child
+            # has exited (the marker write may still be in flight)
+            self._rc = 1
+            return self._rc
 
 
 # the zygote processes THIS process started, keyed by run_dir — kept so
@@ -414,7 +427,7 @@ def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log
         sock.close()
     if status != "ok":
         return None
-    return ZygoteProc(pid)
+    return ZygoteProc(pid, log_base)
 
 
 def launch_worker(spec, incarnation: int, run_dir: str, env: Dict[str, str]):
@@ -428,6 +441,10 @@ def launch_worker(spec, incarnation: int, run_dir: str, env: Dict[str, str]):
     import sys
 
     log_base = os.path.join(run_dir, f"a-{spec.actor_id}-{incarnation}")
+    try:  # a stale marker from a same-(id, incarnation) relaunch would make
+        os.unlink(log_base + ".exit")  # the new child look dead at birth
+    except OSError:
+        pass
     if getattr(spec, "light", True):
         proc = _zygote_spawn(spec, incarnation, run_dir, env, log_base)
         if proc is not None:

@@ -127,15 +127,29 @@ def main() -> None:
     _listener.listen(64)
     _listener.settimeout(0.2)
     parent = os.getppid()
+    children: dict = {}  # pid -> log_base, for exit markers at reap time
     while True:
-        # reap exited children so pid-probe monitors see them disappear
+        # reap exited children; record each child's true exit status in an
+        # ``<log_base>.exit`` marker. Monitors hold only a pid (the child is
+        # reaped HERE, by its true parent), and a raw pid probe lies twice:
+        # it reports "alive" after pid reuse, and it can never recover the
+        # exit code. The marker is the ground truth ZygoteProc.poll reads.
         while True:
             try:
-                pid, _status = os.waitpid(-1, os.WNOHANG)
+                pid, status = os.waitpid(-1, os.WNOHANG)
             except ChildProcessError:
                 break
             if pid == 0:
                 break
+            log_base = children.pop(pid, None)
+            if log_base is not None:
+                try:
+                    code = os.waitstatus_to_exitcode(status)
+                    with open(log_base + ".exit.tmp", "w") as f:
+                        f.write(str(code))
+                    os.replace(log_base + ".exit.tmp", log_base + ".exit")
+                except OSError:
+                    pass
         if os.getppid() != parent:
             os._exit(0)  # the head/agent died; the cluster is gone
         try:
@@ -149,6 +163,7 @@ def main() -> None:
             pid = os.fork()
             if pid == 0:
                 _become_worker(req, conn)  # never returns
+            children[pid] = req["log_base"]
             send_frame(conn, ("ok", pid))
         except Exception:  # noqa: BLE001 - a bad request must not kill the zygote
             import traceback

@@ -73,13 +73,6 @@ def _descend(tree: Tree, binned: np.ndarray) -> np.ndarray:
     return node
 
 
-def _predict_binned(trees: List[Tree], binned: np.ndarray, base: float) -> np.ndarray:
-    pred = np.full(binned.shape[0], base, np.float64)
-    for tree in trees:
-        pred += tree.value[_descend(tree, binned)]
-    return pred
-
-
 def _bin_features(features: np.ndarray, edges: List[np.ndarray]) -> np.ndarray:
     binned = np.empty(features.shape, np.uint8)
     for f in range(features.shape[1]):

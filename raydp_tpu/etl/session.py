@@ -321,7 +321,14 @@ class EtlSession:
                 and time.monotonic() - self._last_stage_ts > self._dyn_idle_s
             ):
                 try:
-                    self.kill_executors(len(self.executors) - self._dyn_min)
+                    # count is recomputed under the lock via min_keep: a
+                    # concurrent explicit kill_executors could shrink the
+                    # pool between this check and the victim selection
+                    self.kill_executors(
+                        len(self.executors),
+                        only_if_idle=True,
+                        min_keep=self._dyn_min,
+                    )
                 except Exception:
                     pass
 
@@ -364,20 +371,37 @@ class EtlSession:
         self._planner.executors = list(self.executors)
         return len(self.executors)
 
-    def kill_executors(self, count: int = 1) -> int:
+    def kill_executors(
+        self, count: int = 1, only_if_idle: bool = False, min_keep: int = 0
+    ) -> int:
         """Scale down by killing ``count`` executors (intentional exit: no
         restart). Their blocks are RE-OWNED to the session master first —
         a graceful scale-down must not destroy still-referenced data (the
         segments survive the process; only owner-death GC would unlink them).
         The reference needs its external shuffle service for the same reason
-        (ray_cluster.py:126-134)."""
+        (ray_cluster.py:126-134).
+
+        ``only_if_idle`` (the dealloc-loop path) makes the idle check and the
+        victim selection one atomic step under the planner's inflight lock:
+        a stage submission increments ``_inflight`` under the same lock
+        before dispatching, so either it lands first (kill aborts) or it
+        blocks until the planner's executor list no longer contains the
+        victims — its tasks can never round-robin onto them."""
         from raydp_tpu.cluster.common import ActorState
 
-        victims = self.executors[-count:] if count else []
-        self.executors = self.executors[: len(self.executors) - len(victims)]
-        # sync the planner BEFORE any kill: a stage submitted during the
-        # (kill + DEAD-drain) window must not round-robin onto victims
-        self._planner.executors = list(self.executors)
+        planner = self._planner
+        with planner._inflight_lock:
+            if only_if_idle and planner._inflight != 0:
+                return len(self.executors)
+            # clamp INSIDE the lock: the pool may have shrunk since the
+            # caller computed ``count``, and the dealloc loop must never
+            # take the pool below minExecutors
+            count = min(count, max(0, len(self.executors) - min_keep))
+            victims = self.executors[-count:] if count else []
+            self.executors = self.executors[: len(self.executors) - len(victims)]
+            # sync the planner BEFORE any kill: a stage submitted during the
+            # (kill + DEAD-drain) window must not round-robin onto victims
+            planner.executors = list(self.executors)
         for handle in victims:
             try:
                 cluster.head_rpc(

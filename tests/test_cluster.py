@@ -293,3 +293,107 @@ def test_zygote_restarts_after_death(runtime):
         assert spawn_s < 1.0, f"spawn took {spawn_s:.2f}s — cold fallback?"
     finally:
         h.kill()
+
+
+def test_agent_spawn_fence_ordering(tmp_path, monkeypatch):
+    """Spawn RPCs land on agent server threads, so a delayed STALE spawn
+    (the fenced-out incarnation whose reply the head lost) can arrive after
+    the newer respawn already runs on the agent. Ordering — not inequality —
+    must decide who dies: the stale spawn is refused (its proc reaped), and
+    the newer healthy worker is never killed or displaced."""
+    import cloudpickle
+
+    from raydp_tpu.cluster import agent as agent_mod
+    from raydp_tpu.cluster.common import ActorSpec
+
+    launched, killed = [], []
+
+    class FakeProc:
+        def __init__(self, incarnation):
+            self.pid = 10_000 + len(launched)
+            self.incarnation = incarnation
+
+        def poll(self):
+            return None  # alive until explicitly "killed" below
+
+    def fake_launch(spec, incarnation, run_dir, env):
+        proc = FakeProc(incarnation)
+        launched.append(proc)
+        return proc
+
+    import raydp_tpu.cluster.common as common_mod
+
+    monkeypatch.setattr(common_mod, "launch_worker", fake_launch)
+    monkeypatch.setattr(agent_mod.os, "killpg", lambda pid, sig: killed.append(pid))
+
+    agent = agent_mod.NodeAgent(
+        "tcp://127.0.0.1:1", "127.0.0.1", {}, "test-ns", str(tmp_path)
+    )
+    blob = cloudpickle.dumps(Counter)
+    spec = ActorSpec(
+        actor_id="a1",
+        name=None,
+        cls_blob=blob,
+        args_blob=cloudpickle.dumps(((), {})),
+        resources={},
+    )
+
+    # incarnation 2 (the healthy respawn) lands first
+    assert agent.handle_spawn_actor(spec, 2, "") is True
+    healthy = agent.children["a1"].proc
+
+    # the delayed stale incarnation-1 spawn must be refused pre-fork
+    assert agent.handle_spawn_actor(spec, 1, "") is False
+    assert agent.children["a1"].proc is healthy
+    assert healthy.pid not in killed
+    assert len(launched) == 1  # fenced BEFORE forking
+
+    # a duplicate delivery of the current incarnation is a no-op too
+    assert agent.handle_spawn_actor(spec, 2, "") is False
+    assert agent.children["a1"].proc is healthy
+
+    # a genuinely newer incarnation replaces (and kills) the old worker
+    assert agent.handle_spawn_actor(spec, 3, "") is True
+    assert agent.children["a1"].incarnation == 3
+    assert healthy.pid in killed
+
+    # the fence must survive the children-table entry: after the monitor
+    # reports a death and deletes the entry, a delayed stale spawn must
+    # STILL be refused, or it would resurrect a fenced-out incarnation as
+    # a leaked live process nothing ever kills
+    del agent.children["a1"]
+    assert agent.handle_spawn_actor(spec, 2, "") is False
+    assert "a1" not in agent.children
+    assert agent.handle_spawn_actor(spec, 4, "") is True
+
+
+def test_zygote_exit_marker_records_death(runtime):
+    """The zygote reaps its forked children, so monitors hold only a pid; the
+    ``<log_base>.exit`` marker is what lets ZygoteProc.poll see a death even
+    after pid reuse (ADVICE r3: raw pid probes can report alive forever)."""
+    import glob
+    import signal
+
+    class Mortal:
+        def pid(self):
+            return os.getpid()
+
+    h = cluster.spawn(Mortal, name="exit-marker-probe", light=True)
+    worker_pid = h.pid.remote().result()
+    os.kill(worker_pid, signal.SIGKILL)
+    sd = cluster.session_dir()
+    # pin the glob to THIS worker's log_base: the session dir is shared
+    # across the module, and another test's marker must not satisfy (or
+    # confuse) this assertion
+    pattern = os.path.join(sd, f"a-{h._actor_id}-*.exit")
+    deadline = time.monotonic() + 10.0
+    markers = []
+    while time.monotonic() < deadline:
+        markers = [p for p in glob.glob(pattern) if os.path.getsize(p) > 0]
+        if markers:
+            break
+        time.sleep(0.1)
+    assert markers, "zygote wrote no .exit marker for a SIGKILLed child"
+    codes = {open(p).read().strip() for p in markers}
+    assert str(-signal.SIGKILL) in codes  # waitstatus_to_exitcode convention
+    h.kill(no_restart=True)
