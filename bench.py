@@ -132,13 +132,14 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     cmp = interleaved_fit_vs_pure(
         est, ds, trained,
         lambda: pure_jax_throughput(MLPRegressor(), mse, x, y, batch, epochs),
+        lambda: pure_jax_scan_throughput(MLPRegressor(), mse, x, y, batch, epochs),
     )
     return trained, t_gen, t_etl, cmp
 
 
 
 
-N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 4))
+N_SAMPLES = int(os.environ.get("BENCH_SAMPLES", 3))
 
 
 def warm_probe():
@@ -157,15 +158,22 @@ def warm_probe():
     jax.block_until_ready(x)
 
 
-def interleaved_fit_vs_pure(est, ds, trained, pure_fn, n_samples=N_SAMPLES):
+def interleaved_fit_vs_pure(est, ds, trained, loop_fn, scan_fn, n_samples=N_SAMPLES):
     """Alternate pure-JAX and framework samples so the tunnel's throughput
     drift (sustained ~300-500k sps with unpredictable multi-x bursts) hits
-    BOTH sides of the comparison equally; the ratio compares medians of
-    co-sampled rounds instead of two medians taken minutes apart."""
+    ALL sides of the comparison equally; ratios compare medians of co-sampled
+    rounds instead of medians taken minutes apart.
+
+    TWO pure-JAX baselines run: the classic per-step jit loop AND a
+    whole-epoch ``lax.scan`` with one-shot device staging — the same shape
+    the estimator trains with. ``pure_jax_sps`` (the denominator of every
+    vs_* ratio) is the STRONGER of the two medians: a ratio against the
+    weaker baseline would measure the baseline's dispatch handicap, not
+    framework quality (VERDICT r3 weak #1)."""
     import statistics
 
     warm_probe()
-    pures, fits, compiles = [], [], []
+    loops, scans, fits, compiles = [], [], [], []
 
     def one_fit():
         t0 = time.perf_counter()
@@ -173,22 +181,26 @@ def interleaved_fit_vs_pure(est, ds, trained, pure_fn, n_samples=N_SAMPLES):
         compiles.append(est.compile_seconds_)
         fits.append(time.perf_counter() - t0 - est.compile_seconds_)
 
+    sides = [lambda: loops.append(loop_fn()), lambda: scans.append(scan_fn()), one_fit]
+    # rotate which side goes first: the tunnel often gives the first
+    # dispatch burst after idle/warm-up a multi-x boost, and a fixed order
+    # would hand that boost to one side systematically. Round the sample
+    # count UP to a multiple of len(sides) so every side leads equally —
+    # otherwise the extra rounds re-introduce exactly that bias.
+    n_samples = -(-n_samples // len(sides)) * len(sides)
     for i in range(n_samples):
-        # alternate which side goes first: the tunnel often gives the first
-        # dispatch burst after idle/warm-up a multi-x boost, and a fixed
-        # order would hand that boost to one side systematically
-        if i % 2 == 0:
-            pures.append(pure_fn())
-            one_fit()
-        else:
-            one_fit()
-            pures.append(pure_fn())
+        for j in range(len(sides)):
+            sides[(i + j) % len(sides)]()
     fit_s = statistics.median(fits)
-    pure_sps = statistics.median(pures)
+    loop_sps = statistics.median(loops)
+    scan_sps = statistics.median(scans)
+    pure_sps = max(loop_sps, scan_sps)
     return {
         "train_s": round(fit_s, 2),
         "compile_s": round(max(compiles), 2),
         "train_only_sps": round(trained / fit_s, 1),
+        "pure_jax_loop_sps": round(loop_sps, 1),
+        "pure_jax_scan_sps": round(scan_sps, 1),
         "pure_jax_sps": round(pure_sps, 1),
         "train_vs_pure": round((trained / fit_s) / pure_sps, 4),
     }
@@ -214,10 +226,10 @@ def pure_jax_throughput(model, loss_fn, x, y, batch: int, epochs: int) -> float:
         updates, opt_state = tx.update(grads, opt_state, params)
         return optax.apply_updates(params, updates), opt_state, loss
 
-    params, opt_state, _ = step(
+    params, opt_state, loss = step(
         params, opt_state, jnp.asarray(x[:batch]), jnp.asarray(y[:batch])
     )
-    jax.block_until_ready(params)
+    float(loss)
     n_rows = len(x)
     steps_per_epoch = n_rows // batch
     order = np.arange(n_rows)
@@ -227,16 +239,78 @@ def pure_jax_throughput(model, loss_fn, x, y, batch: int, epochs: int) -> float:
         np.random.default_rng(epoch).shuffle(order)
         for s in range(steps_per_epoch):
             idx = order[s * batch : (s + 1) * batch]
-            params, opt_state, _ = step(
+            params, opt_state, loss = step(
                 params, opt_state, jnp.asarray(x[idx]), jnp.asarray(y[idx])
             )
             count += 1
             if count % 32 == 0:
                 # same queue-depth cap as the estimator (sync_every_steps):
-                # unbounded async queues degrade the tunnel ~25x permanently
-                jax.block_until_ready(params)
-    jax.block_until_ready(params)
+                # unbounded async queues degrade the tunnel ~25x permanently.
+                # VALUE fetch, not block_until_ready — the latter can return
+                # early on this tunneled plugin (and an early return would
+                # both undercount time and defeat the queue cap)
+                float(loss)
+    float(loss)  # the final fence transitively waits on the whole chain
     return steps_per_epoch * batch * epochs / (time.perf_counter() - t0)
+
+
+def pure_jax_scan_throughput(model, loss_fn, x, y, batch: int, epochs: int) -> float:
+    """The STRONGEST pure-JAX implementation of the same training run: the
+    whole dataset staged on device once, each epoch one jitted dispatch that
+    gathers shuffled batches device-side and ``lax.scan``s the step over
+    them — exactly the one-shot staging the estimator's scan runner uses
+    (jax_estimator._build_scan_runner). This is the denominator BASELINE.md's
+    "≥80% of pure-JAX" north star has to mean to be honest: a per-step-
+    dispatch loop measures the transport, not the chip."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    import optax
+
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), jnp.asarray(x[:batch]))
+    tx = optax.adam(1e-3)
+    opt_state = tx.init(params)
+    n_rows = len(x)
+    steps_per_epoch = n_rows // batch
+    n_used = steps_per_epoch * batch
+
+    def step(carry, xy):
+        params, opt_state = carry
+        xb, yb = xy
+
+        def compute(p):
+            return loss_fn(model.apply(p, xb), yb)
+
+        loss, grads = jax.value_and_grad(compute)(params)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        return (optax.apply_updates(params, updates), opt_state), loss
+
+    @jax.jit
+    def epoch(params, opt_state, xs, ys, perm):
+        xb = xs[perm].reshape(steps_per_epoch, batch, x.shape[1])
+        yb = ys[perm].reshape((steps_per_epoch, batch) + y.shape[1:])
+        (params, opt_state), losses = lax.scan(step, (params, opt_state), (xb, yb))
+        return params, opt_state, losses.sum()
+
+    # one-shot H2D staging, uncommitted (committed arrays force a slow
+    # executor path on some PJRT plugins — mirrors the estimator's staging)
+    xs_dev = jnp.asarray(x)
+    ys_dev = jnp.asarray(y)
+    order0 = np.arange(n_rows)
+    np.random.default_rng(0).shuffle(order0)
+    params, opt_state, loss = epoch(
+        params, opt_state, xs_dev, ys_dev, jnp.asarray(order0[:n_used].astype(np.int32))
+    )
+    float(loss)  # compile + stage outside the clock (value fetch: the only
+    # reliable fence on this tunneled plugin — see pure_jax_throughput)
+    t0 = time.perf_counter()
+    for e in range(epochs):
+        order = np.arange(n_rows)
+        np.random.default_rng(e).shuffle(order)
+        perm = jnp.asarray(order[:n_used].astype(np.int32))
+        params, opt_state, loss = epoch(params, opt_state, xs_dev, ys_dev, perm)
+    float(loss)
+    return n_used * epochs / (time.perf_counter() - t0)
 
 DLRM_VOCABS = [100_000, 10_000, 1_000, 1_000, 100, 100]
 DLRM_DENSE = 8
@@ -321,6 +395,7 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
     cmp = interleaved_fit_vs_pure(
         est, ds, trained,
         lambda: pure_jax_throughput(model, bce, x, y, batch, epochs),
+        lambda: pure_jax_scan_throughput(model, bce, x, y, batch, epochs),
     )
     e2e_sps = trained / (t_etl + cmp["train_s"])
     return {
@@ -462,11 +537,162 @@ def validate_flash_compiled():
     }
 
 
+# bf16 peak FLOP/s per jax device, matched by substring of device_kind.
+# v2/v3 expose one device per CORE (half a chip); v4+ one per chip.
+_TPU_PEAK_FLOPS = [
+    ("v6", 918e12),  # Trillium / v6e
+    ("v5p", 459e12),
+    ("v5", 197e12),  # v5e / "v5 lite"
+    ("v4", 275e12),
+    ("v3", 61.5e12),
+    ("v2", 22.5e12),
+]
+
+
+def _device_peak_flops():
+    import jax
+
+    kind = jax.devices()[0].device_kind
+    low = kind.lower()
+    for sub, peak in _TPU_PEAK_FLOPS:
+        if sub in low:
+            return kind, peak
+    return kind, None
+
+
+def lm_train_flops_per_step(batch, seq, d_model, num_layers, vocab):
+    """Analytic matmul FLOPs of one TransformerLM training step (fwd+bwd,
+    no remat): per token per layer 24*d^2 (qkv 6d^2, proj 2d^2, mlp 16d^2)
+    plus causal attention 2*d*(T+1) (QK^T + AV at average context (T+1)/2),
+    plus the d*V lm_head; backward costs 2x forward."""
+    per_token = num_layers * (24 * d_model**2 + 2 * d_model * (seq + 1))
+    per_token += 2 * d_model * vocab
+    return 3 * batch * seq * per_token
+
+
+def bench_transformer_lm():
+    """MXU-bound single-chip workload: a causal TransformerLM at long
+    sequence, flash (pallas) vs einsum attention, reporting tokens/sec and
+    an MFU estimate from the model's analytic FLOPs (VERDICT r3 weak #2 —
+    every other tracked number is dispatch/ETL-dominated; this one measures
+    the chip). Interleaved samples for tunnel-drift fairness. ok:false on
+    any failure — never discards the run's other numbers."""
+    import statistics
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from raydp_tpu.models import TransformerLM
+
+    on_tpu = jax.default_backend() == "tpu"
+    T = int(os.environ.get("BENCH_LM_T", 8192 if on_tpu else 256))
+    # head_dim 128 (8 heads): fills the MXU's contraction dim — measured
+    # ~1.6x faster attention than head_dim 64 on v5e at T=8k
+    d_model = int(os.environ.get("BENCH_LM_D", 1024 if on_tpu else 128))
+    num_layers = int(os.environ.get("BENCH_LM_LAYERS", 4 if on_tpu else 2))
+    num_heads = 8
+    vocab = 2048
+    batch = int(os.environ.get("BENCH_LM_BATCH", 1))
+    steps = int(os.environ.get("BENCH_LM_STEPS", 8))
+    n_samples = int(os.environ.get("BENCH_LM_SAMPLES", 3))
+    flops_step = lm_train_flops_per_step(batch, T, d_model, num_layers, vocab)
+
+    rng = np.random.default_rng(17)
+    tok_host = rng.integers(0, vocab, (batch, T + 1), dtype=np.int32)
+
+    def make_runner(impl):
+        model = TransformerLM(
+            vocab_size=vocab, d_model=d_model, num_heads=num_heads,
+            num_layers=num_layers, max_len=T + 1, attn_impl=impl,
+        )
+        tokens = jnp.asarray(tok_host[:, :-1])
+        targets = jnp.asarray(tok_host[:, 1:])
+        params = jax.jit(model.init)(jax.random.PRNGKey(0), tokens)
+        tx = optax.adam(3e-4)
+        opt_state = tx.init(params)
+
+        @jax.jit
+        def step(params, opt_state, tok, tgt):
+            def compute(p):
+                logits = model.apply(p, tok)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, tgt
+                ).mean()
+
+            loss, grads = jax.value_and_grad(compute)(params)
+            updates, opt_state = tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        state = {"params": params, "opt": opt_state}
+
+        def run_once():
+            p, o = state["params"], state["opt"]
+            p, o, loss = step(p, o, tokens, targets)  # warm (compile cached)
+            float(loss)  # VALUE fetch: block_until_ready can return EARLY on
+            # this tunneled plugin (measured: 0.1ms "block" vs 4.4s of real
+            # compute) — a D2H of the final loss is the only reliable fence,
+            # and it transitively waits on every step in the chain
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                p, o, loss = step(p, o, tokens, targets)
+            float(loss)
+            dt = time.perf_counter() - t0
+            state["params"], state["opt"] = p, o
+            return steps * batch * T / dt
+
+        return run_once
+
+    try:
+        warm_probe()
+        flash_run = make_runner("flash")
+        einsum_run = make_runner("full")
+        flash_tps, einsum_tps = [], []
+        for i in range(n_samples):
+            if i % 2 == 0:
+                flash_tps.append(flash_run())
+                einsum_tps.append(einsum_run())
+            else:
+                einsum_tps.append(einsum_run())
+                flash_tps.append(flash_run())
+        flash_med = statistics.median(flash_tps)
+        einsum_med = statistics.median(einsum_tps)
+        kind, peak = _device_peak_flops()
+        return {
+            "ok": True,
+            "seq_len": T,
+            "d_model": d_model,
+            "num_layers": num_layers,
+            "batch": batch,
+            "tokens_per_sec": round(flash_med, 1),
+            "einsum_tokens_per_sec": round(einsum_med, 1),
+            "flash_vs_einsum": round(flash_med / einsum_med, 4),
+            "step_ms": round(batch * T / flash_med * 1000, 2),
+            "flops_per_step": flops_step,
+            # MFU of the HEADLINE (flash) path — not a silent max over
+            # variants: the number must describe the same run tokens_per_sec
+            # reports
+            "model_flops_per_sec": round(flash_med * flops_step / (batch * T), 1),
+            "device_kind": kind,
+            "peak_flops": peak,
+            "mfu": (
+                round(flash_med * flops_step / (batch * T) / peak, 4)
+                if peak
+                else None
+            ),
+        }
+    except Exception as e:  # pragma: no cover - hardware-specific failures
+        return {"ok": False, "error": repr(e)[:300]}
+
+
 def main():
     _maybe_force_cpu()
     n_rows = int(os.environ.get("BENCH_ROWS", 200_000))
     batch = int(os.environ.get("BENCH_BATCH", 1024))
-    epochs = int(os.environ.get("BENCH_EPOCHS", 3))
+    # 8 epochs: enough training compute (~1.6M samples) that per-fit fixed
+    # costs (one H2D round, one history fetch ≈ a tunnel RTT each) don't
+    # dominate the measurement for ANY side of the comparison
+    epochs = int(os.environ.get("BENCH_EPOCHS", 8))
 
     trained, t_gen, t_etl, cmp = bench_framework(n_rows, batch, epochs)
     framework_sps = trained / (t_etl + cmp["train_s"])
@@ -484,9 +710,9 @@ def main():
     dlrm = bench_dlrm(
         int(os.environ.get("BENCH_DLRM_ROWS", 100_000)),
         int(os.environ.get("BENCH_DLRM_BATCH", 2048)),
-        # 4 epochs (reference DLRM notebook trains 30): amortizes the fixed
+        # 8 epochs (reference DLRM notebook trains 30): amortizes the fixed
         # ETL cost over a realistic-but-short training run
-        int(os.environ.get("BENCH_DLRM_EPOCHS", 4)),
+        int(os.environ.get("BENCH_DLRM_EPOCHS", 8)),
     )
 
     result = {
@@ -505,6 +731,7 @@ def main():
             "epochs": epochs,
             **cmp,
             "dlrm": dlrm,
+            "lm": bench_transformer_lm(),
             "parallel_steps": bench_parallel_steps(),
             "flash_compiled": validate_flash_compiled(),
         },

@@ -355,9 +355,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         return staged
 
     def clear_staging_cache(self) -> None:
-        """Release the staged host arrays (they can be dataset-sized; the
-        cache otherwise lives as long as the estimator)."""
+        """Release the staged host arrays AND the device-resident copy of
+        the most recent training set (both can be dataset-sized; they
+        otherwise live as long as the estimator)."""
         self._stage_cache = {}
+        self._device_stage = None
 
     # ------------------------------------------------------------------
     # fit
@@ -685,9 +687,17 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     self._save_checkpoint(params, epoch, opt_state)
                     self._gc_step_checkpoints(epoch)
 
-        for record in self._history:  # one sync at the end
-            loss_sum, steps = record["train_loss"]
-            record["train_loss"] = float(loss_sum) / max(steps, 1)
+        if self._history:
+            # ONE device stack + ONE host fetch for every epoch's loss: a
+            # per-record float() would pay a full transport round trip PER
+            # EPOCH (~70ms each on tunneled PJRT — measured 0.56s of pure
+            # RTT for an 8-epoch fit whose compute takes 0.14s)
+            stacked = np.asarray(
+                jnp.stack([rec["train_loss"][0] for rec in self._history])
+            )
+            for rec, val in zip(self._history, stacked):
+                _, steps = rec["train_loss"]
+                rec["train_loss"] = float(val) / max(steps, 1)
         self._module = module
         # keep params ON DEVICE: a device_get here drags the full parameter
         # set (MBs of embedding tables for DLRM) through the host transfer
@@ -857,14 +867,29 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             from raydp_tpu.exchange.jax_io import _mesh_single_device
 
             device = _mesh_single_device(mesh)
-            if device != jax.devices()[0]:
-                xs_dev = jax.device_put(feats, device)
-                ys_dev = jax.device_put(labs, device)
+            cached = getattr(self, "_device_stage", None)
+            if (
+                cached is not None
+                and cached[0] is train_source
+                and cached[1] == device
+            ):
+                # repeated fits on the same staged data skip the H2D upload
+                # (~160ms for 4MB over a tunneled transport, vs ~120ms of
+                # actual compute at small configs). ONE slot on the
+                # estimator — only the most recent dataset stays pinned in
+                # HBM; released by clear_staging_cache() or the next dataset.
+                xs_dev, ys_dev = cached[2], cached[3]
             else:
-                # default device: stay uncommitted (committed arrays force a
-                # slow executor path on some PJRT plugins — see device_put_batch)
-                xs_dev = jnp.asarray(feats)
-                ys_dev = jnp.asarray(labs)
+                if device != jax.devices()[0]:
+                    xs_dev = jax.device_put(feats, device)
+                    ys_dev = jax.device_put(labs, device)
+                else:
+                    # default device: stay uncommitted (committed arrays
+                    # force a slow executor path on some PJRT plugins — see
+                    # device_put_batch)
+                    xs_dev = jnp.asarray(feats)
+                    ys_dev = jnp.asarray(labs)
+                self._device_stage = (train_source, device, xs_dev, ys_dev)
 
             def make_gather(length):
                 def seg_gather(params, opt_state, xs, ys, perm):

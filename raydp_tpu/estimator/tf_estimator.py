@@ -15,7 +15,6 @@ names (strings), rebuilt inside the strategy scope on every worker.
 
 from __future__ import annotations
 
-import socket
 from typing import Any, Dict, List, Optional, Sequence
 
 import numpy as np
@@ -23,36 +22,25 @@ import numpy as np
 from raydp_tpu.estimator.base import EstimatorInterface, EtlEstimatorInterface
 
 
-def _free_ports(n: int) -> List[int]:
-    sockets, ports = [], []
-    for _ in range(n):
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        sockets.append(s)
-        ports.append(s.getsockname()[1])
-    for s in sockets:
-        s.close()
-    return ports
-
-
 class _TFWorkerFn:
     """Picklable per-rank training closure."""
 
-    def __init__(self, config: Dict[str, Any], shards, eval_shards, ports: List[int]):
+    def __init__(self, config: Dict[str, Any], shards, eval_shards, addrs: List[str]):
         self.config = config
         self.shards = shards
         self.eval_shards = eval_shards
-        self.ports = ports
+        self.addrs = addrs
 
     def __call__(self, ctx):
         import json
         import os
 
+        # cluster spec = every rank's OWN host:port (job.worker_addresses),
+        # so MWMS collectives rendezvous across hosts — the reference gets
+        # this from Ray Train's TF_CONFIG assembly (tf/estimator.py:160)
         os.environ["TF_CONFIG"] = json.dumps(
             {
-                "cluster": {
-                    "worker": [f"127.0.0.1:{p}" for p in self.ports]
-                },
+                "cluster": {"worker": list(self.addrs)},
                 "task": {"type": "worker", "index": ctx.rank},
             }
         )
@@ -219,12 +207,15 @@ class TFEstimator(EstimatorInterface, EtlEstimatorInterface):
                     if evaluate_ds is not None
                     else None
                 )
-                ports = _free_ports(self.num_workers)
-                worker_fn = _TFWorkerFn(cfg, shards, eval_shards, ports)
                 job = create_spmd_job(
                     world_size=self.num_workers, placement_strategy="SPREAD"
                 ).start()
                 try:
+                    # resolve AFTER start: each rank's address must point at
+                    # the host it actually landed on, not the driver's
+                    worker_fn = _TFWorkerFn(
+                        cfg, shards, eval_shards, job.worker_addresses()
+                    )
                     results = job.run(worker_fn, timeout=900.0)
                 finally:
                     job.stop()

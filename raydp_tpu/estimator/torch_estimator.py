@@ -18,7 +18,6 @@ This is the CPU/GPU-parity path; the TPU-native flagship is JaxEstimator.
 
 from __future__ import annotations
 
-import socket
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import numpy as np
@@ -26,16 +25,10 @@ import numpy as np
 from raydp_tpu.estimator.base import EstimatorInterface, EtlEstimatorInterface
 
 
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("127.0.0.1", 0))
-        return s.getsockname()[1]
-
-
 class _TorchWorkerFn:
     """Picklable per-rank training closure (shipped via the SPMD job)."""
 
-    def __init__(self, estimator: "TorchEstimator", shards, eval_shards, port: int):
+    def __init__(self, estimator: "TorchEstimator", shards, eval_shards, addr: str):
         self.est_config = {
             "model": estimator._model_arg,
             "optimizer": estimator._optimizer_arg,
@@ -50,16 +43,20 @@ class _TorchWorkerFn:
         }
         self.shards = shards
         self.eval_shards = eval_shards
-        self.port = port
+        self.addr = addr
 
     def __call__(self, ctx):
         import torch
         import torch.distributed as dist
 
         cfg = self.est_config
+        # the gloo store binds on RANK 0's node (job.rendezvous_address),
+        # so ranks the SPREAD placement lands on other hosts can join —
+        # the reference gets this from Ray Train's cross-host rendezvous
+        # (torch/estimator.py:311-327)
         dist.init_process_group(
             "gloo",
-            init_method=f"tcp://127.0.0.1:{self.port}",
+            init_method=f"tcp://{self.addr}",
             rank=ctx.rank,
             world_size=ctx.world_size,
         )
@@ -203,11 +200,15 @@ class TorchEstimator(EstimatorInterface, EtlEstimatorInterface):
                     if evaluate_ds is not None
                     else None
                 )
-                worker_fn = _TorchWorkerFn(self, shards, eval_shards, _free_port())
                 job = create_spmd_job(
                     world_size=self.num_workers, placement_strategy="SPREAD"
                 ).start()
                 try:
+                    # resolve AFTER start: the rendezvous must live where
+                    # rank 0 actually landed, not on the driver's host
+                    worker_fn = _TorchWorkerFn(
+                        self, shards, eval_shards, job.rendezvous_address()
+                    )
                     results = job.run(worker_fn, timeout=600.0)
                 finally:
                     job.stop()

@@ -31,16 +31,8 @@ def _attend(q, k, v, *, impl: str, axis: str, causal: bool):
     if impl == "flash":
         from raydp_tpu.ops.flash_attention import flash_attention
 
-        # pick the largest power-of-two block dividing T (kernel requires
-        # exact tiling; "full"/"ring" have no such restriction)
-        def _block(t):
-            for b in (128, 64, 32, 16, 8, 4, 2, 1):
-                if t % b == 0:
-                    return b
-
-        return flash_attention(
-            q, k, v, causal, _block(q.shape[2]), _block(k.shape[2])
-        )
+        # default blocks = pick_blocks: the measured-fastest large tiles
+        return flash_attention(q, k, v, causal)
     if impl == "ring":
         return ring_attention(q, k, v, axis_name=axis, causal=causal)
     if impl == "ring_flash":

@@ -270,9 +270,8 @@ def ulysses_attention(
     if use_flash:
         from raydp_tpu.ops.flash_attention import flash_attention
 
-        tg = qg.shape[2]
-        block = next(b for b in (128, 64, 32, 16, 8, 4, 2, 1) if tg % b == 0)
-        og = flash_attention(qg, kg, vg, causal, block, block)
+        # default blocks = pick_blocks: the measured-fastest large tiles
+        og = flash_attention(qg, kg, vg, causal)
         return heads_to_seq(og)
     tg = qg.shape[2]
     scale = d**-0.5

@@ -193,25 +193,56 @@ class SpmdJob:
             self._started = True
             return self
 
+    def _worker_host_port(self, rank: int, port: int = 0) -> str:
+        """``host:port`` on the given rank's node. The host comes from the
+        rank's actor record, not the driver's loopback — ranks placed on
+        other machines must be able to reach it; the port is picked ON the
+        rank's host (the driver cannot probe another machine's port space)."""
+        worker = self._workers[rank]
+        try:
+            record = worker._record()
+            host = record.node_ip if record and record.node_ip else "127.0.0.1"
+        except Exception:
+            host = "127.0.0.1"
+        if port == 0:
+            port = worker.pick_free_port.options(
+                timeout=self.timeout
+            ).remote().result()
+        return f"{host}:{port}"
+
+    def rendezvous_address(self, port: int = 0) -> str:
+        """``host:port`` on RANK 0's node, for any single-coordinator
+        worker-group rendezvous (jax.distributed coordinator, torch gloo
+        store, ...). Ray Train plays this role for the reference's
+        estimators (torch/estimator.py:311-327)."""
+        return self._worker_host_port(0, port)
+
+    def worker_addresses(self) -> List[str]:
+        """One reachable ``host:port`` per rank (each port picked on that
+        rank's own host) — the cluster spec an all-workers rendezvous like
+        TF's ``TF_CONFIG`` needs. Port picks fan out concurrently: serial
+        round trips would cost 2·world_size control-plane RTTs per fit."""
+        futures = [
+            w.pick_free_port.options(timeout=self.timeout).remote()
+            for w in self._workers
+        ]
+        addrs = []
+        for w, f in zip(self._workers, futures):
+            try:
+                record = w._record()
+                host = record.node_ip if record and record.node_ip else "127.0.0.1"
+            except Exception:
+                host = "127.0.0.1"
+            addrs.append(f"{host}:{f.result()}")
+        return addrs
+
     def bootstrap_jax(self, coordinator_port: int = 0) -> List[int]:
         """Bring up jax.distributed across all ranks; returns per-rank global
         device counts. The coordinator binds on RANK 0's node — its address
         is resolved from rank 0's actor record, not the driver's loopback,
         so multi-host jobs rendezvous correctly (round-1 ADVICE: the old
         127.0.0.1 address silently broke off the driver's host)."""
-        rank0 = self._workers[0]
-        try:
-            record = rank0._record()
-            host = record.node_ip if record and record.node_ip else "127.0.0.1"
-        except Exception:
-            host = "127.0.0.1"
-        if coordinator_port == 0:
-            # a free port on rank 0's HOST (ask the rank itself: the driver
-            # cannot probe another machine's port space)
-            coordinator_port = rank0.pick_free_port.options(
-                timeout=self.timeout
-            ).remote().result()
-        address = f"{host}:{coordinator_port}"
+        address = self.rendezvous_address(coordinator_port)
         futures = [
             w.bootstrap_jax_distributed.options(timeout=self.timeout).remote(
                 address, self.world_size, rank
