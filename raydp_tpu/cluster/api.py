@@ -154,6 +154,13 @@ def connect_cluster(address: str, token: Optional[str] = None) -> str:
             root = os.path.join(tempfile.gettempdir(), "raydp_tpu")
             os.makedirs(root, exist_ok=True)
             local_dir = tempfile.mkdtemp(prefix="client-", dir=root)
+            # record the head address in the client dir too: handles pickled
+            # by this client embed this dir, and a process resolving them
+            # without our env finds the tcp address here (resolve_head_addr)
+            from raydp_tpu.cluster.common import HEAD_TCP_FILE
+
+            with open(os.path.join(local_dir, HEAD_TCP_FILE), "w") as f:
+                f.write(address)
             set_env[HEAD_ADDR_ENV] = address
             set_env[TOKEN_ENV] = token
             if SHM_NS_ENV not in os.environ:

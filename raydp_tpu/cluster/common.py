@@ -160,11 +160,25 @@ def safe_shm_name(shm_name: str) -> str:
 def resolve_head_addr(session_dir: str) -> str:
     """The head's address for THIS process: remote processes (spawned via a
     node agent) carry it in the environment; head-local ones use the Unix
-    socket in the session dir."""
+    socket in the session dir. A dir WITHOUT a head socket is a tcp://
+    client's local dir — its ``head_tcp.addr`` file (written at attach)
+    carries the address, so handles pickled BY the client resolve anywhere
+    in the cluster (an actor holding such a handle has neither the client's
+    env nor its head socket)."""
     env_addr = os.environ.get(HEAD_ADDR_ENV)
     if env_addr:
         return env_addr
-    return head_sock_path(session_dir)
+    sock = head_sock_path(session_dir)
+    if not os.path.exists(sock):
+        tcp_file = os.path.join(session_dir, HEAD_TCP_FILE)
+        try:
+            with open(tcp_file) as f:
+                addr = f.read().strip()
+            if addr:
+                return addr
+        except OSError:
+            pass
+    return sock
 
 
 def shm_namespace() -> str:

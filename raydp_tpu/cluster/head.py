@@ -452,7 +452,12 @@ class Head:
         env["RAYDP_TPU_ACTOR_ID"] = spec.actor_id
         env["RAYDP_TPU_NODE_ID"] = actor.node_id
         env["RAYDP_TPU_NODE_IP"] = node.node_ip
-        from raydp_tpu.cluster.common import launch_worker
+        from raydp_tpu.cluster.common import HEAD_ADDR_ENV, launch_worker
+
+        # head-local workers resolve the head from the env too: a handle
+        # pickled by a tcp:// client embeds the CLIENT's local dir, which
+        # has no head socket (and on another machine doesn't exist at all)
+        env.setdefault(HEAD_ADDR_ENV, head_sock_path(self.session_dir))
 
         actor.proc = launch_worker(spec, actor.incarnation, self.session_dir, env)
 
@@ -672,6 +677,50 @@ class Head:
                 object_id, owner, shm_name, size, node_id, shm_ns
             )
             return True
+
+    def handle_object_put_proxy_chunk(self, object_id: str, seq: int, payload: bytes):
+        """One chunk of a large proxied put (the client chunks to stay under
+        the frame cap); staged until commit."""
+        with self.lock:
+            staging = getattr(self, "_proxy_staging", None)
+            if staging is None:
+                staging = self._proxy_staging = {}
+            staging.setdefault(object_id, {})[seq] = payload
+        return True
+
+    def handle_object_put_proxy_commit(self, object_id: str, owner: str, total_chunks: int):
+        with self.lock:
+            staging = getattr(self, "_proxy_staging", {})
+            chunks = staging.pop(object_id, {})
+        if len(chunks) != total_chunks:
+            raise ClusterError(
+                f"proxied put {object_id}: {len(chunks)}/{total_chunks} "
+                "chunks arrived"
+            )
+        payload = b"".join(chunks[i] for i in range(total_chunks))
+        return self.handle_object_put_proxy(object_id, payload, owner)
+
+    def handle_object_put_proxy(self, object_id: str, payload: bytes, owner: str):
+        """Host a tcp:// client's block on the HEAD node (ray-client put
+        parity: the reference's client drivers proxy ``ray.put`` through the
+        server). The client has no block server, so the head writes the
+        bytes into its own shm (or disk tier) and serves every read; the
+        metadata registers under the head's node for locality."""
+        from raydp_tpu.store.object_store import host_block_locally
+
+        shm_name = host_block_locally(
+            object_id, payload, spill_dir=os.path.join(self.session_dir, "spill")
+        )
+        with self.lock:
+            # registered as a DRIVER block, exactly like a put from a local
+            # driver: readable everywhere (object_lookup's fetch_addr falls
+            # back to the head, which holds the bytes), and invisible to
+            # locality-aware dispatch — proxied source blocks must not pin
+            # every consumer task onto the head node
+            self.objects[object_id] = _ObjectMeta(
+                object_id, owner, shm_name, len(payload), "driver", ""
+            )
+        return True
 
     def handle_object_lookup(self, object_id: str):
         with self.lock:

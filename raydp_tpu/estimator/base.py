@@ -28,13 +28,32 @@ class EtlEstimatorInterface(ABC):
     the exchange layer (reference fit_on_spark, torch/estimator.py:332-363)."""
 
     def _check_and_convert(self, df):
+        """Adopt the input as an ETL DataFrame. A plain pandas DataFrame is
+        distributed through the running session transparently — the
+        reference accepts pandas-on-Spark frames the same way
+        (spark/interfaces.py:27-39, utils.py:116-122)."""
         from raydp_tpu.etl.dataframe import DataFrame
 
-        if not isinstance(df, DataFrame):
-            raise TypeError(
-                f"expected raydp_tpu.etl.DataFrame, got {type(df).__name__}"
-            )
-        return df
+        if isinstance(df, DataFrame):
+            return df
+        try:
+            import pandas as pd
+        except ImportError:  # pragma: no cover
+            pd = None
+        if pd is not None and isinstance(df, pd.DataFrame):
+            from raydp_tpu.etl.session import active_session
+
+            session = active_session()
+            if session is None:
+                raise RuntimeError(
+                    "fit_on_etl received a pandas DataFrame but no ETL "
+                    "session is running; call raydp_tpu.init_etl first"
+                )
+            return session.from_pandas(df)
+        raise TypeError(
+            f"expected raydp_tpu.etl.DataFrame or pandas.DataFrame, "
+            f"got {type(df).__name__}"
+        )
 
     def fit_on_etl(
         self,

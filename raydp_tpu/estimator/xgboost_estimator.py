@@ -68,6 +68,26 @@ class _XGBWorkerFn:
         return booster.save_raw().decode("latin1") if ctx.rank == 0 else None
 
 
+def _driver_ip() -> str:
+    """The driver's address as seen from the cluster — workers on other
+    hosts must be able to reach the tracker (loopback only works when every
+    rank shares the driver's machine)."""
+    import socket
+
+    try:
+        from raydp_tpu.cluster.api import head_tcp_addr
+
+        host, port = head_tcp_addr()[len("tcp://"):].rsplit(":", 1)
+        s = socket.socket(socket.AF_INET, socket.SOCK_DGRAM)
+        try:
+            s.connect((host, int(port)))  # no traffic: routing lookup only
+            return s.getsockname()[0]
+        finally:
+            s.close()
+    except Exception:
+        return "127.0.0.1"
+
+
 def _start_tracker(n_workers: int):
     """Driver-side rendezvous tracker (the role xgboost_ray's tracker plays in
     the reference). Returns (tracker_or_None, worker_args)."""
@@ -75,7 +95,7 @@ def _start_tracker(n_workers: int):
         return None, {}
     from xgboost.tracker import RabitTracker
 
-    tracker = RabitTracker(host_ip="127.0.0.1", n_workers=n_workers)
+    tracker = RabitTracker(host_ip=_driver_ip(), n_workers=n_workers)
     tracker.start()
     args = tracker.worker_args()
     return tracker, dict(args)

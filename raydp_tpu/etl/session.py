@@ -574,4 +574,7 @@ def stop_etl(cleanup_data: bool = True, del_obj_holder: bool = True) -> None:
 
 
 def active_session() -> Optional[EtlSession]:
-    return _active_session
+    """The running session from init_etl, or None once stopped/absent."""
+    if _active_session is not None and not _active_session._stopped:
+        return _active_session
+    return None

@@ -193,22 +193,23 @@ class SpmdJob:
             self._started = True
             return self
 
-    def _worker_host_port(self, rank: int, port: int = 0) -> str:
-        """``host:port`` on the given rank's node. The host comes from the
-        rank's actor record, not the driver's loopback — ranks placed on
-        other machines must be able to reach it; the port is picked ON the
-        rank's host (the driver cannot probe another machine's port space)."""
-        worker = self._workers[rank]
+    def _worker_host(self, rank: int) -> str:
+        """The given rank's node address from its actor record — never the
+        driver's loopback: ranks placed on other machines must reach it."""
         try:
-            record = worker._record()
-            host = record.node_ip if record and record.node_ip else "127.0.0.1"
+            record = self._workers[rank]._record()
+            return record.node_ip if record and record.node_ip else "127.0.0.1"
         except Exception:
-            host = "127.0.0.1"
+            return "127.0.0.1"
+
+    def _worker_host_port(self, rank: int, port: int = 0) -> str:
+        """``host:port`` on the given rank's node; the port is picked ON the
+        rank's host (the driver cannot probe another machine's port space)."""
         if port == 0:
-            port = worker.pick_free_port.options(
+            port = self._workers[rank].pick_free_port.options(
                 timeout=self.timeout
             ).remote().result()
-        return f"{host}:{port}"
+        return f"{self._worker_host(rank)}:{port}"
 
     def rendezvous_address(self, port: int = 0) -> str:
         """``host:port`` on RANK 0's node, for any single-coordinator
@@ -226,15 +227,10 @@ class SpmdJob:
             w.pick_free_port.options(timeout=self.timeout).remote()
             for w in self._workers
         ]
-        addrs = []
-        for w, f in zip(self._workers, futures):
-            try:
-                record = w._record()
-                host = record.node_ip if record and record.node_ip else "127.0.0.1"
-            except Exception:
-                host = "127.0.0.1"
-            addrs.append(f"{host}:{f.result()}")
-        return addrs
+        return [
+            f"{self._worker_host(rank)}:{f.result()}"
+            for rank, f in enumerate(futures)
+        ]
 
     def bootstrap_jax(self, coordinator_port: int = 0) -> List[int]:
         """Bring up jax.distributed across all ranks; returns per-rank global

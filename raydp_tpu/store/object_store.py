@@ -346,11 +346,101 @@ class _SpillBlock:
             self._sealed = True
 
 
+_PROXY_CHUNK = 64 << 20  # stay far under the transport's 1 GiB frame cap
+
+
+def _proxy_put(object_id: str, payload: bytes, owner: Optional[str]) -> None:
+    """Ship a tcp client's block to the head, chunked so arbitrarily large
+    puts never hit the frame-size cap (the read side chunks the same way)."""
+    owner = owner or current_owner()
+    if len(payload) <= _PROXY_CHUNK:
+        cluster_api.head_rpc(
+            "object_put_proxy",
+            object_id=object_id,
+            payload=payload,
+            owner=owner,
+            timeout=120.0,
+        )
+        return
+    view = memoryview(payload)
+    total = -(-len(payload) // _PROXY_CHUNK)
+    for seq in range(total):
+        cluster_api.head_rpc(
+            "object_put_proxy_chunk",
+            object_id=object_id,
+            seq=seq,
+            payload=bytes(view[seq * _PROXY_CHUNK : (seq + 1) * _PROXY_CHUNK]),
+            timeout=120.0,
+        )
+    cluster_api.head_rpc(
+        "object_put_proxy_commit",
+        object_id=object_id,
+        owner=owner,
+        total_chunks=total,
+        timeout=120.0,
+    )
+
+
+class _ProxyBlock:
+    """Writable block for tcp:// client drivers: buffers the Arrow stream
+    locally and ships it to the HEAD at seal, which hosts (and serves) the
+    bytes on its own node — the analog of ray client proxying ``ray.put``
+    through the server (the reference's client-mode tests rely on exactly
+    that). Same interface as WritableBlock/_SpillBlock."""
+
+    def __init__(self, object_id: str):
+        import pyarrow as pa
+
+        self.object_id = object_id
+        self._out = pa.BufferOutputStream()
+        self._sealed = False
+
+    def arrow_sink(self):
+        return self._out
+
+    def seal(self, written: int, owner: Optional[str] = None) -> ObjectRef:
+        if self._sealed:
+            raise ClusterError("block already sealed")
+        buf = self._out.getvalue()
+        _proxy_put(self.object_id, bytes(memoryview(buf)), owner)
+        self._sealed = True
+        return ObjectRef(self.object_id, buf.size)
+
+    def abort(self) -> None:
+        self._sealed = True
+
+
+def host_block_locally(object_id: str, payload: bytes, spill_dir: Optional[str] = None) -> str:
+    """Write bytes into THIS process's node shm (falling back to the disk
+    tier) WITHOUT registering them — the head calls this to host a tcp
+    client's proxied block, then inserts the metadata itself. Returns the
+    shm/file name to register."""
+    n = len(payload)
+    name = _local_shm_name(object_id)
+    if n and not _should_spill(n):
+        lib = _load_native()
+        cbuf = (ctypes.c_char * n).from_buffer_copy(payload)
+        rc = lib.rtpu_shm_put(
+            name.encode(), ctypes.cast(cbuf, ctypes.c_void_p), n
+        )
+        if rc == 0:
+            return name
+    base = spill_dir or _spill_dir()
+    os.makedirs(base, exist_ok=True)
+    path = os.path.join(base, f"rtpu-{object_id}")
+    with open(path, "wb") as f:
+        f.write(payload)
+    return f"file://{path}"
+
+
 def create_block(capacity: int, storage: str = "auto"):
     """A writable block in the requested tier: "auto" prefers shm and spills
     to disk when shm is (nearly) full, "shm" is strict, "disk" forces the
-    spill tier (DISK_ONLY semantics)."""
+    spill tier (DISK_ONLY semantics). tcp:// client drivers get a proxy
+    block hosted on the head at seal (ray-client put parity)."""
     object_id = new_object_id()
+    if cluster_api.is_tcp_client():
+        return _ProxyBlock(object_id)
     if storage == "disk":
         return _SpillBlock(object_id, capacity)
     if storage == "auto" and _should_spill(capacity):
@@ -369,6 +459,11 @@ def put(data, owner: Optional[str] = None, storage: str = "auto") -> ObjectRef:
 
     buf = data if isinstance(data, pa.Buffer) else pa.py_buffer(data)
     object_id = new_object_id()
+    if cluster_api.is_tcp_client():
+        # proxy through the head (ray-client put parity): the client has no
+        # block server, so the head hosts and serves the bytes
+        _proxy_put(object_id, bytes(memoryview(buf)), owner)
+        return ObjectRef(object_id, buf.size)
     if storage == "disk" or (storage == "auto" and _should_spill(buf.size)):
         return _put_spill(object_id, buf, owner)
     lib = _load_native()

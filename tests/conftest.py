@@ -66,3 +66,75 @@ def cpu_mesh_devices():
     devices = jax.devices()
     assert len(devices) >= 8, f"expected 8 virtual CPU devices, got {devices}"
     return devices
+
+
+# ---------------------------------------------------------------------------
+# two-mode matrix (reference conftest.py:45-52 runs every test locally AND
+# through a ray:// client driver): with RAYDP_TPU_TEST_ATTACH_TCP=1, every
+# cluster.init() in the suite starts a DEDICATED server cluster in a separate
+# process (with exactly the resources the test asked for) and attaches this
+# driver to it over tcp:// with the auth token — so the whole module runs
+# through the client attach path (auth, shm namespaces, proxied puts,
+# cross-namespace reads), and destructive tests (node kills, zygote kills)
+# hit their own throwaway cluster namespace.
+# ---------------------------------------------------------------------------
+
+ATTACH_TCP_ENV = "RAYDP_TPU_TEST_ATTACH_TCP"
+
+if os.environ.get(ATTACH_TCP_ENV):
+    import atexit
+    import json
+    import subprocess
+
+    import raydp_tpu.cluster
+    import raydp_tpu.cluster.api as _capi
+
+    _real_shutdown = _capi.shutdown
+    _server_procs = []
+
+    _SERVER_CODE = """
+import json, sys, time
+from raydp_tpu.cluster import api
+kwargs = json.loads(sys.argv[1])
+sd = api.init(**kwargs)
+print(json.dumps({"tcp": api.head_tcp_addr(), "token": api.cluster_token()}),
+      flush=True)
+while True:
+    time.sleep(3600)
+"""
+
+    def _attach_init(num_cpus=None, memory=None, resources=None, session_root=None):
+        if _capi._session_dir is not None:
+            return _capi._session_dir
+        env = dict(os.environ)
+        env.pop(ATTACH_TCP_ENV, None)
+        # the server is the cluster OWNER: it must not itself attach
+        for var in ("RAYDP_TPU_SESSION", "RAYDP_TPU_HEAD_ADDR",
+                    "RAYDP_TPU_TOKEN", "RAYDP_TPU_SHM_NS"):
+            env.pop(var, None)
+        env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        kwargs = {"num_cpus": num_cpus, "memory": memory, "resources": resources}
+        proc = subprocess.Popen(
+            [sys.executable, "-c", _SERVER_CODE, json.dumps(kwargs)],
+            stdout=subprocess.PIPE, text=True, env=env,
+        )
+        _server_procs.append(proc)
+        line = proc.stdout.readline()
+        info = json.loads(line)
+        return _capi.connect_cluster(info["tcp"], token=info["token"])
+
+    def _attach_shutdown(*args, **kwargs):
+        _real_shutdown(*args, **kwargs)  # client mode: detaches only
+        while _server_procs:
+            proc = _server_procs.pop()
+            proc.terminate()  # SIGTERM → the server's atexit tears down
+            try:
+                proc.wait(timeout=30)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+    _capi.init = _attach_init
+    _capi.shutdown = _attach_shutdown
+    raydp_tpu.cluster.init = _attach_init
+    raydp_tpu.cluster.shutdown = _attach_shutdown
+    atexit.register(_attach_shutdown)

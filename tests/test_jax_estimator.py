@@ -427,3 +427,47 @@ def test_keep_checkpoints_retention(session):
     est.fit(ds)
     names = sorted(os.listdir(ckpt))
     assert names == ["epoch_3", "epoch_4"], names
+
+
+def test_fit_on_etl_accepts_pandas(session):
+    """A plain pandas DataFrame is adopted via the running session
+    (reference accepts pandas-on-Spark frames, spark/interfaces.py:27-39) —
+    no manual from_pandas required."""
+    from raydp_tpu.models import MLPRegressor
+
+    # an earlier test in this module stops the fixture session via
+    # stop_etl_after_conversion; make sure one is running
+    if raydp_tpu.etl.active_session() is None:
+        raydp_tpu.init_etl(
+            "test-est-pandas", num_executors=2, executor_cores=1,
+            executor_memory="300M",
+        )
+    rng = np.random.default_rng(5)
+    n = 4096
+    x = rng.random(n).astype(np.float32)
+    y = rng.random(n).astype(np.float32)
+    pdf = pd.DataFrame({"x": x, "y": y, "z": 3 * x + 4 * y + 5})
+
+    est = JaxEstimator(
+        model=MLPRegressor(),
+        optimizer="adam",
+        loss="mse",
+        feature_columns=["x", "y"],
+        label_column="z",
+        batch_size=256,
+        num_epochs=6,
+        learning_rate=1e-2,
+        seed=0,
+    )
+    history = est.fit_on_etl(pdf)  # pandas in, not an ETL DataFrame
+    assert history[-1]["train_loss"] < history[0]["train_loss"] * 0.2
+
+
+def test_fit_on_etl_rejects_junk_input(session):
+    from raydp_tpu.models import MLPRegressor
+
+    est = JaxEstimator(
+        model=MLPRegressor(), feature_columns=["x"], label_column="y"
+    )
+    with pytest.raises(TypeError, match="DataFrame"):
+        est.fit_on_etl([1, 2, 3])

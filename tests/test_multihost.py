@@ -307,3 +307,67 @@ def test_spilled_block_fetched_cross_node(two_nodes):
         assert store.stats["remote_fetches"] == fetched_before + 1
     finally:
         actor.kill()
+
+
+def test_spill_aware_locality(two_nodes):
+    """Blocks in the agent node's DISK tier still dispatch their consumers
+    to that node (ROADMAP r3 #4): the head's location table keys on
+    node_id, which the spill tier preserves at registration — proven by the
+    query running entirely on the spill-owning node with ZERO cross-node
+    block serves (the only way another node could read a namespaced spill
+    file is through the agent's block server, and its counter is flat)."""
+    from raydp_tpu.etl.expressions import ColumnRef
+
+    agent_node = two_nodes["agent_node"]
+    ex_spill = cluster.spawn(
+        EtlExecutor, 7, "mh-spill", {},
+        name="mh-exec-spill", num_cpus=1,
+        resources={f"node:{agent_node.node_ip}": 0.001},
+        max_restarts=1, max_concurrency=3, light=True,
+        env={"RAYDP_TPU_SHM_CAPACITY": "1"},  # force the disk tier
+    )
+    try:
+        table = pa.table({"x": np.arange(2000)})
+        refs = []
+        for i in range(4):
+            spec = T.TaskSpec(
+                reads=[
+                    T.ReadSpec(
+                        "inline",
+                        inline_ipc=T.table_to_ipc_bytes(table.slice(i * 500, 500)),
+                        schema_ipc=T.schema_ipc_bytes(table.schema),
+                    )
+                ],
+                output=T.OutputSpec("block"),
+            )
+            refs.append(ex_spill.run_task(spec).blocks[0])
+        # every input block is a SPILLED file on the agent node
+        for ref in refs:
+            meta = cluster.head_rpc("object_lookup", object_id=ref.object_id)
+            assert meta["shm_name"].startswith("file://"), meta["shm_name"]
+            assert meta["node_id"] == agent_node.node_id
+
+        planner = Planner(
+            [two_nodes["executors"][0], ex_spill], default_parallelism=4
+        )
+        node = lp.Project(
+            lp.ArrowSource(refs, table.schema), [("x", ColumnRef("x"))]
+        )
+        served_before = _agent_stats(two_nodes["agent"])["blocks_served"]
+        mat = planner.materialize(node)
+        stage = planner.last_query_stats["stages"][0]
+        assert stage["locality_preferred"] == 4  # every task had a preference
+        locations = cluster.head_rpc(
+            "object_locations",
+            object_ids=[b.object_id for b in mat.blocks if b is not None],
+        )
+        assert set(locations.values()) == {agent_node.node_id}
+        assert mat.num_rows == 2000
+        # no cross-node pull happened: the spilled inputs were read from
+        # the local disk tier by the co-located executor
+        assert _agent_stats(two_nodes["agent"])["blocks_served"] == served_before
+    finally:
+        try:
+            ex_spill.kill()
+        except Exception:
+            pass

@@ -1,0 +1,68 @@
+"""Tracker half of the xgboost test double: a real TCP rendezvous server.
+
+Accepts exactly ``n_workers`` connections, reads one (value, weight) pair
+from each, and replies to every worker with the global sums — the minimal
+honest analog of the Rabit allreduce the real tracker coordinates.
+"""
+
+from __future__ import annotations
+
+import socket
+import struct
+import threading
+
+
+class RabitTracker:
+    def __init__(self, host_ip: str, n_workers: int):
+        self.n_workers = int(n_workers)
+        self.host_ip = host_ip
+        self._server = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._server.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._server.bind((host_ip, 0))
+        self._server.listen(self.n_workers)
+        self.port = self._server.getsockname()[1]
+        self._thread: threading.Thread | None = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+
+    def _serve(self) -> None:
+        conns = []
+        try:
+            self._server.settimeout(120)
+            while len(conns) < self.n_workers:
+                conn, _ = self._server.accept()
+                conns.append(conn)
+            pairs = []
+            for conn in conns:
+                data = b""
+                while len(data) < 16:
+                    chunk = conn.recv(16 - len(data))
+                    if not chunk:
+                        raise ConnectionError("worker hung up mid-allreduce")
+                    data += chunk
+                pairs.append(struct.unpack("!dd", data))
+            total = sum(p[0] for p in pairs)
+            n = sum(p[1] for p in pairs)
+            reply = struct.pack("!dd", total, n)
+            for conn in conns:
+                conn.sendall(reply)
+        finally:
+            for conn in conns:
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+            self._server.close()
+
+    def worker_args(self) -> dict:
+        return {
+            "dmlc_tracker_uri": self.host_ip,
+            "dmlc_tracker_port": self.port,
+            "n_workers": self.n_workers,
+        }
+
+    def wait_for(self, timeout: float = 60.0) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
