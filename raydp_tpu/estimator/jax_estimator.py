@@ -603,10 +603,13 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     per_epoch_s = (
                         (time.perf_counter() - t_fit) / self.num_epochs
                     )
+                    # the loss placeholder stays None: the final fetch reads
+                    # the whole [E] ``losses`` array directly — slicing
+                    # losses[e] here would dispatch E unused gathers
                     self._history = [
                         {
                             "epoch": e,
-                            "train_loss": (losses[e], steps_per_epoch),
+                            "train_loss": (None, steps_per_epoch),
                             "epoch_seconds": per_epoch_s,
                         }
                         for e in range(self.num_epochs)
@@ -725,13 +728,18 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     self._gc_step_checkpoints(epoch)
 
         if self._history:
-            # ONE device stack + ONE host fetch for every epoch's loss: a
-            # per-record float() would pay a full transport round trip PER
-            # EPOCH (~70ms each on tunneled PJRT — measured 0.56s of pure
-            # RTT for an 8-epoch fit whose compute takes 0.14s)
-            stacked = np.asarray(
-                jnp.stack([rec["train_loss"][0] for rec in self._history])
-            )
+            # ONE host fetch for every epoch's loss: a per-record float()
+            # would pay a full transport round trip PER EPOCH (~70ms each on
+            # tunneled PJRT — measured 0.56s of pure RTT for an 8-epoch fit
+            # whose compute takes 0.14s). The fullfit path already returns
+            # the losses as one [E] array — fetch it directly (no stack
+            # dispatch, one RTT instead of two).
+            if fullfit_done:
+                stacked = np.asarray(losses)
+            else:
+                stacked = np.asarray(
+                    jnp.stack([rec["train_loss"][0] for rec in self._history])
+                )
             for rec, val in zip(self._history, stacked):
                 _, steps = rec["train_loss"]
                 rec["train_loss"] = float(val) / max(steps, 1)
