@@ -597,20 +597,19 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     for e in range(self.num_epochs)
                 ]
                 t_fit = time.perf_counter()
+                compile_before = self.compile_seconds_
                 full = run_fullfit(params, opt_state, seeds)
                 if full is not None:
                     params, opt_state, losses, steps_per_epoch = full
-                    per_epoch_s = (
-                        (time.perf_counter() - t_fit) / self.num_epochs
-                    )
-                    # the loss placeholder stays None: the final fetch reads
-                    # the whole [E] ``losses`` array directly — slicing
-                    # losses[e] here would dispatch E unused gathers
+                    # the loss/time placeholders stay None: the dispatch is
+                    # ASYNC — real training time is only known at the final
+                    # losses fetch (the fence), which fills both in; and
+                    # slicing losses[e] here would dispatch E unused gathers
                     self._history = [
                         {
                             "epoch": e,
                             "train_loss": (None, steps_per_epoch),
-                            "epoch_seconds": per_epoch_s,
+                            "epoch_seconds": None,
                         }
                         for e in range(self.num_epochs)
                     ]
@@ -735,7 +734,14 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             # the losses as one [E] array — fetch it directly (no stack
             # dispatch, one RTT instead of two).
             if fullfit_done:
-                stacked = np.asarray(losses)
+                stacked = np.asarray(losses)  # the fence: training is done
+                per_epoch_s = (
+                    time.perf_counter()
+                    - t_fit
+                    - (self.compile_seconds_ - compile_before)
+                ) / max(self.num_epochs, 1)
+                for rec in self._history:
+                    rec["epoch_seconds"] = per_epoch_s
             else:
                 stacked = np.asarray(
                     jnp.stack([rec["train_loss"][0] for rec in self._history])

@@ -471,3 +471,44 @@ def test_fit_on_etl_rejects_junk_input(session):
     )
     with pytest.raises(TypeError, match="DataFrame"):
         est.fit_on_etl([1, 2, 3])
+
+
+def test_fullfit_scan_matches_epoch_paths():
+    """The whole-fit scan (one dispatch for all epochs), the per-epoch scan
+    (forced via checkpoint_dir), and the explicit per-step loop
+    (scan_epochs=False) must train IDENTICALLY for the same seed: same host
+    permutations, same step math — per-epoch losses equal to float32
+    tolerance. Guards the fullfit fast path against silent divergence."""
+    from raydp_tpu.models import MLPRegressor
+
+    rng = np.random.default_rng(9)
+    n = 2048
+    x = rng.random((n, 3)).astype(np.float32)
+    y = (x @ np.array([1.0, -2.0, 0.5], np.float32)).astype(np.float32)
+
+    class ArraysDS:
+        def to_numpy(self, fc, lc, feature_dtype=None, label_dtype=None):
+            return x.copy(), y.copy()
+
+    def run(**kw):
+        est = JaxEstimator(
+            model=MLPRegressor(),
+            optimizer="adam",
+            loss="mse",
+            feature_columns=["a", "b", "c"],
+            label_column="l",
+            batch_size=128,
+            num_epochs=3,
+            learning_rate=1e-2,
+            shuffle=True,
+            seed=4,
+            **kw,
+        )
+        return [r["train_loss"] for r in est.fit(ArraysDS())]
+
+    fullfit = run()  # no checkpoint/eval → whole-fit scan
+    # a checkpoint dir disables the fullfit fast path → per-epoch scans
+    per_epoch = run(checkpoint_dir=tempfile.mkdtemp())
+    loop = run(scan_epochs=False)  # true per-step dispatch loop
+    np.testing.assert_allclose(fullfit, per_epoch, rtol=1e-5)
+    np.testing.assert_allclose(fullfit, loop, rtol=1e-4)
