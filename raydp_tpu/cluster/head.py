@@ -688,7 +688,10 @@ class Head:
             staging.setdefault(object_id, {})[seq] = payload
         return True
 
-    def handle_object_put_proxy_commit(self, object_id: str, owner: str, total_chunks: int):
+    def handle_object_put_proxy_commit(
+        self, object_id: str, owner: str, total_chunks: int,
+        storage: str = "auto",
+    ):
         with self.lock:
             staging = getattr(self, "_proxy_staging", {})
             chunks = staging.pop(object_id, {})
@@ -698,9 +701,11 @@ class Head:
                 "chunks arrived"
             )
         payload = b"".join(chunks[i] for i in range(total_chunks))
-        return self.handle_object_put_proxy(object_id, payload, owner)
+        return self.handle_object_put_proxy(object_id, payload, owner, storage)
 
-    def handle_object_put_proxy(self, object_id: str, payload: bytes, owner: str):
+    def handle_object_put_proxy(
+        self, object_id: str, payload: bytes, owner: str, storage: str = "auto"
+    ):
         """Host a tcp:// client's block on the HEAD node (ray-client put
         parity: the reference's client drivers proxy ``ray.put`` through the
         server). The client has no block server, so the head writes the
@@ -709,7 +714,9 @@ class Head:
         from raydp_tpu.store.object_store import host_block_locally
 
         shm_name = host_block_locally(
-            object_id, payload, spill_dir=os.path.join(self.session_dir, "spill")
+            object_id, payload,
+            spill_dir=os.path.join(self.session_dir, "spill"),
+            storage=storage,
         )
         with self.lock:
             # registered as a DRIVER block, exactly like a put from a local

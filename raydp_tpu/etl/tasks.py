@@ -56,11 +56,13 @@ def write_table_block(
         return ref, table.num_rows
     except Exception:
         block.abort()
-        # conservative fallback: serialize to memory, then one copy into shm
+        # conservative fallback: serialize to memory, then one copy into the
+        # store — FORWARDING the tier request (a DISK_ONLY write must not
+        # silently land in shm because the capacity estimate was short)
         out = pa.BufferOutputStream()
         with pa.ipc.new_stream(out, table.schema) as writer:
             writer.write_table(table, max_chunksize=max_records)
-        ref = store.put(out.getvalue(), owner=owner)
+        ref = store.put(out.getvalue(), owner=owner, storage=storage)
         return ref, table.num_rows
 
 

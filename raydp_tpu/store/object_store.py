@@ -349,9 +349,13 @@ class _SpillBlock:
 _PROXY_CHUNK = 64 << 20  # stay far under the transport's 1 GiB frame cap
 
 
-def _proxy_put(object_id: str, payload: bytes, owner: Optional[str]) -> None:
+def _proxy_put(
+    object_id: str, payload: bytes, owner: Optional[str], storage: str = "auto"
+) -> None:
     """Ship a tcp client's block to the head, chunked so arbitrarily large
-    puts never hit the frame-size cap (the read side chunks the same way)."""
+    puts never hit the frame-size cap (the read side chunks the same way).
+    ``storage`` forwards the tier request — ``disk`` must mean DISK_ONLY on
+    the head too, not wherever the head's own shm pressure happens to be."""
     owner = owner or current_owner()
     if len(payload) <= _PROXY_CHUNK:
         cluster_api.head_rpc(
@@ -359,6 +363,7 @@ def _proxy_put(object_id: str, payload: bytes, owner: Optional[str]) -> None:
             object_id=object_id,
             payload=payload,
             owner=owner,
+            storage=storage,
             timeout=120.0,
         )
         return
@@ -377,6 +382,7 @@ def _proxy_put(object_id: str, payload: bytes, owner: Optional[str]) -> None:
         object_id=object_id,
         owner=owner,
         total_chunks=total,
+        storage=storage,
         timeout=120.0,
     )
 
@@ -388,10 +394,12 @@ class _ProxyBlock:
     through the server (the reference's client-mode tests rely on exactly
     that). Same interface as WritableBlock/_SpillBlock."""
 
-    def __init__(self, object_id: str):
+    def __init__(self, object_id: str, capacity: int, storage: str = "auto"):
         import pyarrow as pa
 
         self.object_id = object_id
+        self.capacity = capacity
+        self.storage = storage
         self._out = pa.BufferOutputStream()
         self._sealed = False
 
@@ -401,30 +409,48 @@ class _ProxyBlock:
     def seal(self, written: int, owner: Optional[str] = None) -> ObjectRef:
         if self._sealed:
             raise ClusterError("block already sealed")
+        if written > self.capacity:  # same contract as WritableBlock
+            raise ClusterError(f"wrote {written} past capacity {self.capacity}")
         buf = self._out.getvalue()
-        _proxy_put(self.object_id, bytes(memoryview(buf)), owner)
+        _proxy_put(
+            self.object_id, bytes(memoryview(buf)[:written]), owner,
+            storage=self.storage,
+        )
         self._sealed = True
-        return ObjectRef(self.object_id, buf.size)
+        return ObjectRef(self.object_id, written)
 
     def abort(self) -> None:
         self._sealed = True
 
 
-def host_block_locally(object_id: str, payload: bytes, spill_dir: Optional[str] = None) -> str:
+def host_block_locally(
+    object_id: str, payload: bytes, spill_dir: Optional[str] = None,
+    storage: str = "auto",
+) -> str:
     """Write bytes into THIS process's node shm (falling back to the disk
-    tier) WITHOUT registering them — the head calls this to host a tcp
-    client's proxied block, then inserts the metadata itself. Returns the
-    shm/file name to register."""
+    tier; ``storage="disk"`` forces disk, ``"shm"`` is strict and raises on
+    failure — same tier contract as ``put``) WITHOUT registering them — the
+    head calls this to host a tcp client's proxied block, then inserts the
+    metadata itself. Returns the shm/file name to register."""
     n = len(payload)
     name = _local_shm_name(object_id)
-    if n and not _should_spill(n):
+    want_shm = storage == "shm" or (
+        storage != "disk" and n and not _should_spill(n)
+    )
+    if want_shm:
         lib = _load_native()
-        cbuf = (ctypes.c_char * n).from_buffer_copy(payload)
+        size = max(n, 1)  # empty objects keep a 1-byte segment; the
+        # registered size (len(payload)) stays authoritative
+        cbuf = (ctypes.c_char * size).from_buffer_copy(
+            payload if n else b"\0"
+        )
         rc = lib.rtpu_shm_put(
-            name.encode(), ctypes.cast(cbuf, ctypes.c_void_p), n
+            name.encode(), ctypes.cast(cbuf, ctypes.c_void_p), size
         )
         if rc == 0:
             return name
+        if storage == "shm":  # strict tier: no silent downgrade to disk
+            raise OSError(f"shm put failed (errno={lib.rtpu_errno()})")
     base = spill_dir or _spill_dir()
     os.makedirs(base, exist_ok=True)
     path = os.path.join(base, f"rtpu-{object_id}")
@@ -440,7 +466,7 @@ def create_block(capacity: int, storage: str = "auto"):
     block hosted on the head at seal (ray-client put parity)."""
     object_id = new_object_id()
     if cluster_api.is_tcp_client():
-        return _ProxyBlock(object_id)
+        return _ProxyBlock(object_id, capacity, storage)
     if storage == "disk":
         return _SpillBlock(object_id, capacity)
     if storage == "auto" and _should_spill(capacity):
@@ -462,7 +488,7 @@ def put(data, owner: Optional[str] = None, storage: str = "auto") -> ObjectRef:
     if cluster_api.is_tcp_client():
         # proxy through the head (ray-client put parity): the client has no
         # block server, so the head hosts and serves the bytes
-        _proxy_put(object_id, bytes(memoryview(buf)), owner)
+        _proxy_put(object_id, bytes(memoryview(buf)), owner, storage=storage)
         return ObjectRef(object_id, buf.size)
     if storage == "disk" or (storage == "auto" and _should_spill(buf.size)):
         return _put_spill(object_id, buf, owner)

@@ -212,6 +212,8 @@ CLUSTER_MODULES = [
     "tests/test_cluster.py",
     "tests/test_elasticity.py",
     "tests/test_multihost.py",
+    "tests/test_object_store.py",
+    "tests/test_parity_features.py",
     "tests/test_spmd.py",
 ]
 

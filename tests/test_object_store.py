@@ -157,10 +157,15 @@ def test_ownership_transfer_to_holder_survives_producer(runtime):
 
 def test_spill_put_roundtrip(runtime, monkeypatch):
     """A payload that exceeds the (artificially capped) shm budget lands in
-    the spill tier and reads back identically."""
-    monkeypatch.setenv(store.object_store.SHM_CAPACITY_ENV, "1")
+    the spill tier and reads back identically. In tcp-attached mode the env
+    cap can't steer the HEAD's tier choice, so the disk tier is requested
+    explicitly — the storage hint travels through the proxied put."""
     payload = os.urandom(256 << 10)
-    ref = store.put(payload)
+    if os.environ.get("RAYDP_TPU_TEST_ATTACH_TCP"):
+        ref = store.put(payload, storage="disk")
+    else:
+        monkeypatch.setenv(store.object_store.SHM_CAPACITY_ENV, "1")
+        ref = store.put(payload)
     meta = store.object_store._lookup(ref)
     assert meta["shm_name"].startswith("file://"), meta["shm_name"]
     assert store.get_bytes(ref) == payload
@@ -173,10 +178,16 @@ def test_spill_put_roundtrip(runtime, monkeypatch):
 
 def test_spill_arrow_block_roundtrip(runtime, monkeypatch):
     """The streaming write path (create_block/arrow_sink/seal) spills and
-    round-trips a whole Arrow table."""
-    monkeypatch.setenv(store.object_store.SHM_CAPACITY_ENV, "1")
+    round-trips a whole Arrow table. Attached mode requests the disk tier
+    explicitly (see test_spill_put_roundtrip)."""
+    from raydp_tpu.etl.tasks import write_table_block
+
     table = _make_table(5000, seed=3)
-    ref = _write_table_block(table)
+    if os.environ.get("RAYDP_TPU_TEST_ATTACH_TCP"):
+        ref, _ = write_table_block(table, storage="disk")
+    else:
+        monkeypatch.setenv(store.object_store.SHM_CAPACITY_ENV, "1")
+        ref = _write_table_block(table)
     meta = store.object_store._lookup(ref)
     assert meta["shm_name"].startswith("file://")
     schema, batches = store.read_arrow_batches(ref)
