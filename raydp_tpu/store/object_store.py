@@ -439,13 +439,11 @@ def host_block_locally(
     )
     if want_shm:
         lib = _load_native()
-        size = max(n, 1)  # empty objects keep a 1-byte segment; the
-        # registered size (len(payload)) stays authoritative
-        cbuf = (ctypes.c_char * size).from_buffer_copy(
-            payload if n else b"\0"
-        )
+        # the native layer owns the empty-object invariant (size-0 maps a
+        # 1-byte segment, store.cpp); the registered size stays authoritative
+        cbuf = (ctypes.c_char * max(n, 1)).from_buffer_copy(payload or b"\0")
         rc = lib.rtpu_shm_put(
-            name.encode(), ctypes.cast(cbuf, ctypes.c_void_p), size
+            name.encode(), ctypes.cast(cbuf, ctypes.c_void_p), n
         )
         if rc == 0:
             return name
