@@ -146,16 +146,32 @@ class Expr:
         return Function("coalesce", [self, _to_expr(value)])
 
     def substr(self, start: int, length: int) -> "Expr":
-        """1-based start (Spark convention), mapped to 0-based arrow slice."""
-        return Function(
-            "utf8_slice_codeunits",
-            [self],
-            options={"start": start - 1, "stop": start - 1 + length},
-        )
+        """1-based start (Spark convention; negative counts from the end)."""
+        return substring_expr(self, start, length)
 
 
 def _to_expr(value) -> Expr:
     return value if isinstance(value, Expr) else Literal(value)
+
+
+def substring_expr(child: "Expr", pos: int, length: int) -> "Expr":
+    """Spark ``substring`` semantics over arrow's slice kernel — the ONE
+    place the position convention lives (``Expr.substr`` and
+    ``F.substring`` both call it): 1-based start, 0 treated as 1, negative
+    counts from the end (substring('hello', -2, 2) == 'lo')."""
+    if pos > 0:
+        start = pos - 1
+    elif pos == 0:
+        start = 0
+    else:
+        start = pos
+    if start < 0 and length >= -start:
+        # from-the-end slice reaching the end: a computed non-negative stop
+        # would be read as an absolute position by arrow
+        options = {"start": start}
+    else:
+        options = {"start": start, "stop": start + length}
+    return Function("utf8_slice_codeunits", [child], options=options)
 
 
 @dataclass(eq=False)
@@ -415,16 +431,24 @@ _AGG_PHASES: Dict[str, Tuple[str, str]] = {
 }
 
 
+# aggregates that decompose into SEVERAL partials (mean → sum+count;
+# var/stddev → sum+sum-of-squares+count, merged with the standard
+# E[x²]−E[x]² identity and Bessel correction for the _samp variants)
+_COMPOSITE_AGGS = ("mean", "var_samp", "var_pop", "stddev_samp", "stddev_pop")
+
+
 @dataclass(eq=False)
 class AggExpr:
-    """Aggregation of one input column. ``mean`` decomposes into sum+count."""
+    """Aggregation of one input column. Composite aggregates (``mean``,
+    ``var_*``, ``stddev_*``) decompose into simple partials so the shuffle
+    still ships pre-aggregated blocks."""
 
-    agg: str  # sum | min | max | count | mean | first | last | any | all
+    agg: str  # sum | min | max | count | mean | var_* | stddev_* | first | ...
     column: str
     out_name: str
 
     def __post_init__(self):
-        if self.agg not in _AGG_PHASES and self.agg != "mean":
+        if self.agg not in _AGG_PHASES and self.agg not in _COMPOSITE_AGGS:
             raise ValueError(f"unsupported aggregate {self.agg!r}")
 
     def alias(self, name: str) -> "AggExpr":

@@ -83,6 +83,34 @@ def first(c: ColumnLike) -> AggExpr:
     return AggExpr("first", name, f"first({name})")
 
 
+def stddev(c: ColumnLike) -> AggExpr:
+    """Sample standard deviation (Spark ``stddev`` default; null for n<2)."""
+    name = _colname(c)
+    return AggExpr("stddev_samp", name, f"stddev({name})")
+
+
+stddev_samp = stddev
+
+
+def stddev_pop(c: ColumnLike) -> AggExpr:
+    name = _colname(c)
+    return AggExpr("stddev_pop", name, f"stddev_pop({name})")
+
+
+def variance(c: ColumnLike) -> AggExpr:
+    """Sample variance (Spark ``variance`` default; null for n<2)."""
+    name = _colname(c)
+    return AggExpr("var_samp", name, f"var_samp({name})")
+
+
+var_samp = variance
+
+
+def var_pop(c: ColumnLike) -> AggExpr:
+    name = _colname(c)
+    return AggExpr("var_pop", name, f"var_pop({name})")
+
+
 # -- scalar functions --------------------------------------------------------
 
 
@@ -198,6 +226,145 @@ def to_timestamp(c: ColumnLike, fmt: Optional[str] = None) -> Expr:
 
 
 # -- misc --------------------------------------------------------------------
+
+
+def sin(c: ColumnLike) -> Expr:
+    return Function("sin", [_c(c)])
+
+
+def cos(c: ColumnLike) -> Expr:
+    return Function("cos", [_c(c)])
+
+
+def tan(c: ColumnLike) -> Expr:
+    return Function("tan", [_c(c)])
+
+
+def atan2(y: ColumnLike, x: ColumnLike) -> Expr:
+    return Function("atan2", [_c(y), _c(x)])
+
+
+def pow(base: ColumnLike, exponent) -> Expr:  # noqa: A001 - pyspark name
+    from raydp_tpu.etl.expressions import _to_expr
+
+    # a string exponent is a COLUMN name (pyspark pow(col1, col2) parity);
+    # numbers become literals
+    exp_expr = _c(exponent) if isinstance(exponent, (str, Expr)) else _to_expr(exponent)
+    return Function("power", [_c(base), exp_expr])
+
+
+def signum(c: ColumnLike) -> Expr:
+    return Function("sign", [_c(c)])
+
+
+def greatest(*cols: ColumnLike) -> Expr:
+    return Function("max_element_wise", [_c(c) for c in cols])
+
+
+def least(*cols: ColumnLike) -> Expr:
+    return Function("min_element_wise", [_c(c) for c in cols])
+
+
+def isnull(c: ColumnLike) -> Expr:
+    return Function("is_null", [_c(c)])
+
+
+def isnotnull(c: ColumnLike) -> Expr:
+    return Function("is_valid", [_c(c)])
+
+
+def isnan(c: ColumnLike) -> Expr:
+    return Function("is_nan", [_c(c)])
+
+
+def substring(c: ColumnLike, pos: int, length: int) -> Expr:
+    """Spark ``substring``: 1-based start, negative counts from the end."""
+    from raydp_tpu.etl.expressions import substring_expr
+
+    return substring_expr(_c(c), pos, length)
+
+
+def contains(c: ColumnLike, pattern: str) -> Expr:
+    return Function("match_substring", [_c(c)], options={"pattern": pattern})
+
+
+def startswith(c: ColumnLike, prefix: str) -> Expr:
+    return Function("starts_with", [_c(c)], options={"pattern": prefix})
+
+
+def endswith(c: ColumnLike, suffix: str) -> Expr:
+    return Function("ends_with", [_c(c)], options={"pattern": suffix})
+
+
+def replace(c: ColumnLike, pattern: str, replacement: str) -> Expr:
+    """Literal substring replacement (all occurrences)."""
+    return Function(
+        "replace_substring", [_c(c)],
+        options={"pattern": pattern, "replacement": replacement},
+    )
+
+
+def regexp_replace(c: ColumnLike, pattern: str, replacement: str) -> Expr:
+    """Regex replacement with Spark's ``$N`` capture-group syntax (arrow's
+    RE2 backend natively uses ``\\N``; ``$N`` references are translated so
+    Spark workloads port unchanged)."""
+    import re as _re
+
+    replacement = _re.sub(r"\$(\d+)", r"\\\1", replacement)
+    return Function(
+        "replace_substring_regex", [_c(c)],
+        options={"pattern": pattern, "replacement": replacement},
+    )
+
+
+def rlike(c: ColumnLike, pattern: str) -> Expr:
+    return Function("match_substring_regex", [_c(c)], options={"pattern": pattern})
+
+
+def _pad(c: ColumnLike, width: int, padding: str, kernel: str) -> Expr:
+    # Spark lpad/rpad implicitly CAST non-string inputs and TRUNCATE longer
+    # strings to exactly ``width``; arrow's pad kernels do neither — cast,
+    # pad, then slice
+    import pyarrow as pa
+
+    from raydp_tpu.etl.expressions import Cast
+
+    padded = Function(
+        kernel, [Cast(_c(c), pa.string())],
+        options={"width": width, "padding": padding},
+    )
+    return Function(
+        "utf8_slice_codeunits", [padded], options={"start": 0, "stop": width}
+    )
+
+
+def lpad(c: ColumnLike, width: int, padding: str = " ") -> Expr:
+    return _pad(c, width, padding, "utf8_lpad")
+
+
+def rpad(c: ColumnLike, width: int, padding: str = " ") -> Expr:
+    return _pad(c, width, padding, "utf8_rpad")
+
+
+def second(c: ColumnLike) -> Expr:
+    return Function("second", [_c(c)])
+
+
+def dayofyear(c: ColumnLike) -> Expr:
+    return Function("day_of_year", [_c(c)])
+
+
+def quarter(c: ColumnLike) -> Expr:
+    return Function("quarter", [_c(c)])
+
+
+def weekofyear(c: ColumnLike) -> Expr:
+    return Function("iso_week", [_c(c)])
+
+
+def datediff(end: ColumnLike, start: ColumnLike) -> Expr:
+    """Whole days from ``start`` to ``end`` (Spark argument order)."""
+    return Function("days_between", [_c(start), _c(end)])
 
 
 def hash(c: ColumnLike, num_buckets: Optional[int] = None) -> Expr:  # noqa: A001

@@ -379,12 +379,25 @@ class WindowApply:
 # ---------------------------------------------------------------------------
 
 
+def _is_moment_agg(agg: str) -> bool:
+    return agg in ("var_samp", "var_pop", "stddev_samp", "stddev_pop")
+
+
 def _expand_phases(aggs: Sequence[AggExpr]) -> List[Tuple[str, str, str]]:
-    """(input_col, map_agg, partial_name) triples; mean → sum + count parts."""
+    """(input_col, map_agg, partial_name) triples; mean → sum + count parts;
+    var/stddev → sum + M2 + count, where M2 = n·var_pop is each partition's
+    centered second moment (computed by arrow's own stable variance kernel —
+    the naive Σx² − (Σx)²/n identity catastrophically cancels for
+    large-mean/small-variance data). Partials merge Chan-style in
+    final_agg: ΣM2 plus a between-partials correction."""
     out = []
     for i, a in enumerate(aggs):
         if a.agg == "mean":
             out.append((a.column, "sum", f"__p{i}_sum"))
+            out.append((a.column, "count", f"__p{i}_cnt"))
+        elif _is_moment_agg(a.agg):
+            out.append((a.column, "sum", f"__p{i}_sum"))
+            out.append((a.column, "m2", f"__p{i}_m2"))
             out.append((a.column, "count", f"__p{i}_cnt"))
         else:
             out.append((a.column, _AGG_PHASES[a.agg][0], f"__p{i}"))
@@ -413,10 +426,24 @@ def partial_agg(table: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> pa
         for col_name, map_agg, pname in phases:
             if col_name == "*":
                 specs.append(([], "count_all"))
+            elif map_agg == "m2":
+                # per-group population variance (arrow's numerically stable
+                # kernel); scaled to M2 = n·var below
+                specs.append((col_name, "variance", pc.VarianceOptions(ddof=0)))
             else:
                 specs.append((col_name, map_agg))
         grouped = table.group_by(keys, use_threads=False).aggregate(specs)
-        return _grouped_positional(grouped, keys, [p for _, _, p in phases])
+        result = _grouped_positional(grouped, keys, [p for _, _, p in phases])
+        for i, a in enumerate(aggs):
+            if _is_moment_agg(a.agg):
+                m2 = pc.multiply(
+                    pc.cast(result.column(f"__p{i}_m2"), pa.float64()),
+                    pc.cast(result.column(f"__p{i}_cnt"), pa.float64()),
+                )
+                result = result.set_column(
+                    result.column_names.index(f"__p{i}_m2"), f"__p{i}_m2", m2
+                )
+        return result
     # global aggregation: single partial row
     arrays, names = [], []
     for col_name, map_agg, pname in phases:
@@ -426,6 +453,12 @@ def partial_agg(table: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> pa
             column = table.column(col_name)
             if map_agg == "count":
                 value = pa.scalar(len(column) - column.null_count, pa.int64())
+            elif map_agg == "m2":
+                n = len(column) - column.null_count
+                var = pc.variance(column, ddof=0).as_py() if n else None
+                value = pa.scalar(
+                    var * n if var is not None else None, pa.float64()
+                )
             elif map_agg == "first":
                 value = column[0] if len(column) else pa.scalar(None, column.type)
             elif map_agg == "last":
@@ -437,8 +470,55 @@ def partial_agg(table: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> pa
     return pa.Table.from_arrays(arrays, names=names)
 
 
+def _moment_between_terms(
+    partials: pa.Table, merged: pa.Table, keys: List[str],
+    aggs: Sequence[AggExpr],
+) -> Dict[int, List[float]]:
+    """Per-merged-row between-partials term Σ n_i·(mean_i − mean̄)² for each
+    moment aggregate. Mean DELTAS keep this numerically safe where
+    Σ(sum_i²/n_i) − (Σsum)²/N destroys all significant digits (the deltas
+    are on the spread-of-means scale, not the squared-raw-sum scale). The
+    grouping runs over PARTIAL rows (#partitions × #groups, not data rows)
+    with tuple keys, so null-key groups — which an arrow join would drop —
+    merge correctly."""
+    moment_idx = [i for i, a in enumerate(aggs) if _is_moment_agg(a.agg)]
+    if not moment_idx:
+        return {}
+
+    def _key_rows(table: pa.Table):
+        if not keys:
+            return [()] * table.num_rows
+        cols = [table.column(k).to_pylist() for k in keys]
+        return list(zip(*cols)) if table.num_rows else []
+
+    merged_pos = {t: j for j, t in enumerate(_key_rows(merged))}
+    partial_keys = _key_rows(partials)
+    out: Dict[int, List[float]] = {}
+    for i in moment_idx:
+        sums = partials.column(f"__p{i}_sum").to_pylist()
+        cnts = partials.column(f"__p{i}_cnt").to_pylist()
+        g_sums = merged.column(f"__p{i}_sum").to_pylist()
+        g_cnts = merged.column(f"__p{i}_cnt").to_pylist()
+        between = [0.0] * merged.num_rows
+        for row, key in enumerate(partial_keys):
+            n_i = cnts[row]
+            if not n_i or sums[row] is None:
+                continue
+            j = merged_pos[key]
+            if not g_cnts[j] or g_sums[j] is None:
+                continue
+            delta = sums[row] / n_i - g_sums[j] / g_cnts[j]
+            between[j] += n_i * delta * delta
+        out[i] = between
+    return out
+
+
 def final_agg(partials: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> pa.Table:
-    """Merge partial rows: re-aggregate with each aggregate's merge function."""
+    """Merge partial rows: re-aggregate with each aggregate's merge function.
+    Moment (var/stddev) partials merge Chan-style: the total M2 is
+    ΣM2_i plus the between-partials term Σ(sum_i²/n_i) − (Σsum)²/N, which
+    only cancels between PARTIAL MEANS (similar magnitudes) — not between
+    raw sums of squares."""
     phases = _expand_phases(aggs)
     if keys:
         merge_specs = [
@@ -458,7 +538,9 @@ def final_agg(partials: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> p
             arrays.append(pa.array([value.as_py()], type=value.type))
             names.append(pname)
         merged = pa.Table.from_arrays(arrays, names=names)
-    # finalize: mean = sum/cnt; rename partials to out names
+    between = _moment_between_terms(partials, merged, keys, aggs)
+    # finalize: mean = sum/cnt; var/stddev from the moment identity;
+    # rename partials to out names
     out_arrays = [merged.column(k) for k in keys]
     out_names = list(keys)
     for i, a in enumerate(aggs):
@@ -466,6 +548,26 @@ def final_agg(partials: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> p
             total = merged.column(f"__p{i}_sum")
             cnt = pc.cast(merged.column(f"__p{i}_cnt"), pa.float64())
             out_arrays.append(pc.divide(pc.cast(total, pa.float64()), cnt))
+        elif _is_moment_agg(a.agg):
+            m2_within = pc.cast(merged.column(f"__p{i}_m2"), pa.float64())
+            cnt = pc.cast(merged.column(f"__p{i}_cnt"), pa.float64())
+            # Chan merge: M2 = ΣM2_i + Σ n_i·(mean_i − mean̄)², with the
+            # between term computed from MEAN DELTAS per partial row
+            # (_moment_between_terms) — squared raw sums would cancel
+            # catastrophically for large-mean/small-variance data
+            m2 = pc.add(m2_within, pa.array(between[i], pa.float64()))
+            if a.agg.endswith("_samp"):
+                # Bessel correction; n < 2 → null (Spark stddev/var default)
+                denom = pc.subtract(cnt, pa.scalar(1.0, pa.float64()))
+                denom = pc.if_else(
+                    pc.greater(denom, 0.0), denom, pa.scalar(None, pa.float64())
+                )
+            else:
+                denom = cnt
+            var = pc.divide(m2, denom)
+            out_arrays.append(
+                pc.sqrt(var) if a.agg.startswith("stddev") else var
+            )
         elif a.agg == "count":
             # count over zero partials must be 0, not null (sum of empty = null)
             out_arrays.append(
@@ -484,6 +586,8 @@ def _merge_fns(aggs: Sequence[AggExpr]) -> List[str]:
     for a in aggs:
         if a.agg == "mean":
             out.extend(["sum", "sum"])
+        elif _is_moment_agg(a.agg):
+            out.extend(["sum", "sum", "sum"])
         else:
             out.append(_AGG_PHASES[a.agg][1])
     return out

@@ -588,3 +588,165 @@ def test_window_null_keys_and_int_cumsum(session):
     # cum_sum without an order_by is rejected (undefined running order)
     with pytest.raises(ValueError, match="order_by"):
         F.cum_sum("v").over(F.Window.partition_by("k"))
+
+
+def test_stddev_variance_two_phase(session):
+    """Sample/population stddev and variance decompose into sum/sumsq/count
+    partials and merge EXACTLY like pandas computes them — across multiple
+    partitions, so the two-phase merge path is what is tested."""
+    rng = np.random.default_rng(21)
+    pdf = pd.DataFrame(
+        {"k": rng.integers(0, 5, 1000), "v": rng.standard_normal(1000) * 3 + 1}
+    )
+    df = session.from_pandas(pdf, num_partitions=6)
+
+    out = (
+        df.group_by("k")
+        .agg(F.stddev("v"), F.variance("v"), F.stddev_pop("v"), F.var_pop("v"))
+        .to_pandas()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    exp = (
+        pdf.groupby("k")["v"]
+        .agg(std="std", var="var", std_pop=lambda s: s.std(ddof=0),
+             var_pop=lambda s: s.var(ddof=0))
+        .reset_index()
+        .sort_values("k")
+        .reset_index(drop=True)
+    )
+    np.testing.assert_allclose(out["stddev(v)"], exp["std"], rtol=1e-9)
+    np.testing.assert_allclose(out["var_samp(v)"], exp["var"], rtol=1e-9)
+    np.testing.assert_allclose(out["stddev_pop(v)"], exp["std_pop"], rtol=1e-9)
+    np.testing.assert_allclose(out["var_pop(v)"], exp["var_pop"], rtol=1e-9)
+
+    # global (no keys) + string-name form
+    g = df.agg({"v": "stddev"}).to_pandas()
+    np.testing.assert_allclose(g.iloc[0, 0], pdf["v"].std(), rtol=1e-9)
+
+    # sample stddev of a single row is null, not a crash
+    one = session.from_pandas(pdf.head(1), num_partitions=1)
+    assert pd.isna(one.agg({"v": "stddev"}).to_pandas().iloc[0, 0])
+
+
+def test_scalar_function_batch(session):
+    """The Spark-parity scalar function surface maps to arrow kernels and
+    matches pandas/numpy semantics."""
+    pdf = pd.DataFrame(
+        {
+            "s": ["Hello World", "abcdef", " pad ", "xyz", ""],
+            "x": [1.0, -4.0, 0.25, 9.0, 2.0],
+            "y": [2.0, 2.0, 3.0, 0.5, -1.0],
+            "ts": pd.to_datetime(
+                ["2020-03-15 10:11:12", "2021-12-31 23:59:58",
+                 "2022-01-01 00:00:00", "2020-07-04 12:00:01",
+                 "2019-02-28 06:30:45"]
+            ),
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=2)
+    out = (
+        df.with_column("sub", F.substring("s", 1, 5))
+        .with_column("has", F.contains("s", "cd"))
+        .with_column("sw", F.startswith("s", "He"))
+        .with_column("rep", F.regexp_replace("s", "[aeiou]", "_"))
+        .with_column("pw", F.pow("x", 2))
+        .with_column("gx", F.greatest("x", "y"))
+        .with_column("lx", F.least("x", "y"))
+        .with_column("sg", F.signum("x"))
+        .with_column("sn", F.sin("x"))
+        .with_column("doy", F.dayofyear("ts"))
+        .with_column("q", F.quarter("ts"))
+        .with_column("sec", F.second("ts"))
+        .to_pandas()
+    )
+    assert out["sub"].tolist() == ["Hello", "abcde", " pad ", "xyz", ""]
+    assert out["has"].tolist() == [False, True, False, False, False]
+    assert out["sw"].tolist() == [True, False, False, False, False]
+    assert out["rep"].tolist()[0] == "H_ll_ W_rld"
+    np.testing.assert_allclose(out["pw"], pdf["x"] ** 2)
+    np.testing.assert_allclose(out["gx"], np.maximum(pdf["x"], pdf["y"]))
+    np.testing.assert_allclose(out["lx"], np.minimum(pdf["x"], pdf["y"]))
+    np.testing.assert_allclose(out["sg"], np.sign(pdf["x"]))
+    np.testing.assert_allclose(out["sn"], np.sin(pdf["x"]), rtol=1e-12)
+    assert out["doy"].tolist() == pdf["ts"].dt.dayofyear.tolist()
+    assert out["q"].tolist() == pdf["ts"].dt.quarter.tolist()
+    assert out["sec"].tolist() == pdf["ts"].dt.second.tolist()
+
+
+def test_function_spark_edge_semantics(session):
+    """Spark-divergence edges: pow with a column exponent, lpad/rpad
+    truncation, regexp_replace $N capture groups."""
+    pdf = pd.DataFrame({"x": [2.0, 3.0], "y": [3.0, 2.0], "s": ["abcdef", "a"]})
+    df = session.from_pandas(pdf, num_partitions=1)
+    out = (
+        df.with_column("p", F.pow("x", "y"))          # column exponent
+        .with_column("lp", F.lpad("s", 3, "*"))       # truncates to width
+        .with_column("rp", F.rpad("s", 3, "*"))
+        .with_column("rr", F.regexp_replace("s", "(a)", "$1!"))
+        .to_pandas()
+    )
+    np.testing.assert_allclose(out["p"], [8.0, 9.0])
+    assert out["lp"].tolist() == ["abc", "**a"]
+    assert out["rp"].tolist() == ["abc", "a**"]
+    assert out["rr"].tolist() == ["a!bcdef", "a!"]
+
+
+def test_variance_numerically_stable(session):
+    """Large-mean/small-variance data: the naive Σx² − (Σx)²/n identity
+    cancels catastrophically in f64 (returns 0); the Chan-style partial
+    merge (per-partition M2 from arrow's stable kernel + between-partials
+    correction) must recover the true variance."""
+    rng = np.random.default_rng(3)
+    # adversarial: large mean, small variance, PRIME row count over many
+    # partitions (unequal splits, so partial means genuinely differ — a
+    # sum-of-squares identity is off by ~1e9x in this regime)
+    base = 1e9
+    vals = base + rng.standard_normal(679) * 1e-3
+    pdf = pd.DataFrame({"k": ([0, 1] * 340)[:679], "v": vals})
+    df = session.from_pandas(pdf, num_partitions=7)
+    out = (
+        df.group_by("k").agg(F.var_pop("v"), F.stddev("v"))
+        .to_pandas().sort_values("k").reset_index(drop=True)
+    )
+    exp = (
+        pdf.groupby("k")["v"]
+        .agg(vp=lambda s: s.var(ddof=0), sd="std").reset_index()
+    )
+    # rtol 1e-4: arrow's within-partition variance kernel and pandas'
+    # two-pass differ at ~1e-5 relative in this regime; the naive
+    # sum-of-squares identity would be off by ~1e9x
+    np.testing.assert_allclose(out["var_pop(v)"], exp["vp"], rtol=1e-4)
+    np.testing.assert_allclose(out["stddev(v)"], exp["sd"], rtol=1e-4)
+
+    # extreme regime: deviations near the ulp of the mean (1e11 ± 1e-4,
+    # ulp≈1.5e-5) — the DATA itself is quantized; stay within a few percent
+    # of pandas instead of exploding by 1e14x like the naive identity
+    vals2 = 1e11 + rng.standard_normal(679) * 1e-4
+    pdf2 = pd.DataFrame({"k": [0] * 679, "v": vals2})
+    out2 = (
+        session.from_pandas(pdf2, num_partitions=7)
+        .group_by("k").agg(F.var_pop("v")).to_pandas()
+    )
+    np.testing.assert_allclose(
+        out2["var_pop(v)"][0], pdf2["v"].var(ddof=0), rtol=0.05
+    )
+
+
+def test_substring_spark_semantics(session):
+    """Negative positions count from the end (Spark substring('hello',-2,2)
+    == 'lo'); Expr.substr and F.substring share one implementation."""
+    pdf = pd.DataFrame({"s": ["hello", "ab", ""]})
+    df = session.from_pandas(pdf, num_partitions=1)
+    out = (
+        df.with_column("tail2", F.substring("s", -2, 2))
+        .with_column("head3", F.substring("s", 1, 3))
+        .with_column("mid", F.col("s").substr(2, 2))
+        .with_column("neg_short", F.substring("s", -4, 2))
+        .to_pandas()
+    )
+    assert out["tail2"].tolist() == ["lo", "ab", ""]
+    assert out["head3"].tolist() == ["hel", "ab", ""]
+    assert out["mid"].tolist() == ["el", "b", ""]
+    # negative start with short length: 4th-from-end, take 2 → "el"
+    assert out["neg_short"].tolist()[0] == "el"
