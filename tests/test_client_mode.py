@@ -183,26 +183,7 @@ def test_core_suite_through_attached_driver(running_cluster):
     env["RAYDP_TPU_SESSION"] = running_cluster["session_dir"]
     env.pop("RAYDP_TPU_HEAD_ADDR", None)
     env.pop("RAYDP_TPU_SHM_NS", None)
-    def run_inner():
-        return subprocess.run(
-            [
-                sys.executable, "-m", "pytest", *CORE_MODULES,
-                "-q", "-p", "no:cacheprovider",
-            ],
-            cwd=ROOT, env=env, capture_output=True, text=True, timeout=1500,
-        )
-
-    out = run_inner()
-    if out.returncode != 0:
-        # the single-core CI machine makes the inner 60-test run load-
-        # sensitive when the outer slow tier drains concurrently; one retry
-        # distinguishes real breakage from scheduling flake
-        print(f"client-mode suite first attempt failed, retrying:\n"
-              f"{out.stdout[-2500:]}\n{out.stderr[-1000:]}")
-        out = run_inner()
-    assert out.returncode == 0, (
-        f"client-mode suite failed:\n{out.stdout[-4000:]}\n{out.stderr[-2000:]}"
-    )
+    _run_pytest_with_retry(CORE_MODULES, env, 1500)
     # the attached driver's shutdown() calls are detaches — the shared
     # cluster must have survived the whole inner suite
     assert cluster.head_rpc("ping") == "pong"
@@ -220,7 +201,11 @@ CLUSTER_MODULES = [
 
 def _run_attached_pytest(modules, extra_env=None, timeout=1500):
     """Run an inner pytest with every cluster.init tcp-attached to a
-    dedicated server cluster (conftest RAYDP_TPU_TEST_ATTACH_TCP)."""
+    dedicated server cluster (conftest RAYDP_TPU_TEST_ATTACH_TCP). One
+    retry, like the core-modules attached run: on the single-core CI
+    machine the inner multi-process run is load-sensitive when the outer
+    slow tier drains concurrently — a retry distinguishes real breakage
+    from scheduling flake."""
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join([ROOT] + sys.path)
     env["RAYDP_TPU_TEST_ATTACH_TCP"] = "1"
@@ -230,12 +215,40 @@ def _run_attached_pytest(modules, extra_env=None, timeout=1500):
         "RAYDP_TPU_SHM_NS",
     ):
         env.pop(var, None)
-    out = subprocess.run(
-        [sys.executable, "-m", "pytest", *modules, "-q", "-p", "no:cacheprovider"],
-        cwd=ROOT, env=env, capture_output=True, text=True, timeout=timeout,
-    )
+
+    _run_pytest_with_retry(modules, env, timeout)
+
+
+def _run_pytest_with_retry(modules, env, timeout):
+    """Inner pytest with ONE retry covering both failure modes of a loaded
+    single-core machine: nonzero exit AND TimeoutExpired. Shared by every
+    launcher in this module so the retry policy cannot drift."""
+
+    def run_inner():
+        try:
+            return subprocess.run(
+                [
+                    sys.executable, "-m", "pytest", *modules,
+                    "-q", "-p", "no:cacheprovider",
+                ],
+                cwd=ROOT, env=env, capture_output=True, text=True,
+                timeout=timeout,
+            )
+        except subprocess.TimeoutExpired as exc:
+            return subprocess.CompletedProcess(
+                exc.cmd, returncode=-1,
+                stdout=(exc.stdout or b"").decode(errors="replace")
+                if isinstance(exc.stdout, bytes) else (exc.stdout or ""),
+                stderr=f"inner pytest timed out after {timeout}s",
+            )
+
+    out = run_inner()
+    if out.returncode != 0:
+        print(f"inner suite first attempt failed, retrying:\n"
+              f"{out.stdout[-2500:]}\n{out.stderr[-1000:]}")
+        out = run_inner()
     assert out.returncode == 0, (
-        f"tcp-attached run failed:\n{out.stdout[-4000:]}\n{out.stderr[-2000:]}"
+        f"inner suite failed:\n{out.stdout[-4000:]}\n{out.stderr[-2000:]}"
     )
 
 
