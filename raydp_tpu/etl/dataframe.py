@@ -197,6 +197,30 @@ class DataFrame:
 
         return self.map_batches(adapter)
 
+    def explode(self, column: str) -> "DataFrame":
+        """One output row per element of a list column, other columns
+        repeated (Spark ``explode`` semantics: rows with null/empty lists
+        are dropped). A narrow per-partition transform — no shuffle."""
+
+        def _explode(table: pa.Table) -> pa.Table:
+            import pyarrow.compute as pc
+
+            col = table.column(column)
+            if isinstance(col, pa.ChunkedArray):
+                col = col.combine_chunks()
+            parents = pc.list_parent_indices(col)
+            flat = pc.list_flatten(col)
+            arrays = []
+            for name in table.column_names:
+                if name == column:
+                    arrays.append(flat)
+                else:
+                    arrays.append(pc.take(table.column(name), parents))
+            return pa.Table.from_arrays(arrays, names=table.column_names)
+
+        return self.map_batches(_explode)
+
+
     mapInPandas = map_in_pandas
 
     # ------------------------------------------------------------------
@@ -363,7 +387,8 @@ class DataFrame:
         print(self.limit(n).to_pandas().to_string())
 
     def describe(self, *cols: str) -> "DataFrame":
-        """count/mean/min/max per numeric column (Spark describe parity)."""
+        """count/mean/stddev/min/max per numeric column, one row per statistic
+        with a leading ``summary`` column (Spark describe shape)."""
         import pyarrow.types as pat
 
         from raydp_tpu.etl import functions as F
@@ -383,17 +408,41 @@ class DataFrame:
                 "describe: no numeric columns"
                 + (f" among {list(cols)}" if cols else f" in {self.columns}")
             )
-        aggs = []
-        for c in numeric:
-            aggs.extend(
-                [
-                    F.count(c).alias(f"count({c})"),
-                    F.avg(c).alias(f"mean({c})"),
-                    F.min(c).alias(f"min({c})"),
-                    F.max(c).alias(f"max({c})"),
-                ]
-            )
-        return self.agg(*aggs)
+        import pandas as pd
+
+        # single source for the statistic rows: each entry builds its
+        # aggregate AND names the partial it reads back
+        stat_aggs = [
+            ("count", F.count),
+            ("mean", F.avg),
+            ("stddev", F.stddev),
+            ("min", F.min),
+            ("max", F.max),
+        ]
+        aggs = [
+            fn(c).alias(f"__{stat}_{c}")
+            for c in numeric
+            for stat, fn in stat_aggs
+        ]
+        row = self.agg(*aggs).collect()[0]
+        # values are STRINGS, like Spark's describe: one pandas column holds
+        # five mixed statistics, and float64 coercion would silently round
+        # int64 count/min/max beyond 2^53
+        pdf = pd.DataFrame(
+            {
+                "summary": [stat for stat, _ in stat_aggs],
+                **{
+                    c: [
+                        None
+                        if row[f"__{stat}_{c}"] is None
+                        else str(row[f"__{stat}_{c}"])
+                        for stat, _ in stat_aggs
+                    ]
+                    for c in numeric
+                },
+            }
+        )
+        return self._session.from_pandas(pdf, num_partitions=1)
 
     def cache(self) -> "DataFrame":
         """Materialize to object-store blocks and replace the plan with the

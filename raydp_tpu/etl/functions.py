@@ -346,6 +346,15 @@ def rpad(c: ColumnLike, width: int, padding: str = " ") -> Expr:
     return _pad(c, width, padding, "utf8_rpad")
 
 
+def split(c: ColumnLike, pattern: str, regex: bool = False) -> Expr:
+    """Split a string column into a list column (pair with
+    ``DataFrame.explode``). ``regex=True`` treats ``pattern`` as a regular
+    expression (Spark's ``split`` is always regex; literal splitting is the
+    fast path here)."""
+    kernel = "split_pattern_regex" if regex else "split_pattern"
+    return Function(kernel, [_c(c)], options={"pattern": pattern})
+
+
 def second(c: ColumnLike) -> Expr:
     return Function("second", [_c(c)])
 

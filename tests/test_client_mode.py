@@ -225,21 +225,33 @@ def _run_pytest_with_retry(modules, env, timeout):
     launcher in this module so the retry policy cannot drift."""
 
     def run_inner():
+        # own process group: on timeout the WHOLE tree (incl. attach-mode
+        # dedicated server clusters, which would otherwise orphan and sink
+        # the retry on this single-core machine) is killed before retrying
+        proc = subprocess.Popen(
+            [
+                sys.executable, "-m", "pytest", *modules,
+                "-q", "-p", "no:cacheprovider",
+            ],
+            cwd=ROOT, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE, text=True, start_new_session=True,
+        )
         try:
-            return subprocess.run(
-                [
-                    sys.executable, "-m", "pytest", *modules,
-                    "-q", "-p", "no:cacheprovider",
-                ],
-                cwd=ROOT, env=env, capture_output=True, text=True,
-                timeout=timeout,
-            )
-        except subprocess.TimeoutExpired as exc:
+            stdout, stderr = proc.communicate(timeout=timeout)
             return subprocess.CompletedProcess(
-                exc.cmd, returncode=-1,
-                stdout=(exc.stdout or b"").decode(errors="replace")
-                if isinstance(exc.stdout, bytes) else (exc.stdout or ""),
-                stderr=f"inner pytest timed out after {timeout}s",
+                proc.args, proc.returncode, stdout, stderr
+            )
+        except subprocess.TimeoutExpired:
+            import signal as _signal
+
+            try:
+                os.killpg(proc.pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError):
+                pass
+            stdout, stderr = proc.communicate()
+            return subprocess.CompletedProcess(
+                proc.args, -1, stdout or "",
+                f"inner pytest timed out after {timeout}s\n{stderr or ''}",
             )
 
     out = run_inner()

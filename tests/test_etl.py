@@ -258,10 +258,16 @@ def test_describe(session):
     df = session.range(100, num_partitions=4).with_column(
         "x", F.col("id").cast("float32") * 2
     )
-    row = df.describe().collect()[0]
-    assert row["count(id)"] == 100
-    assert row["mean(id)"] == pytest.approx(49.5)
-    assert row["min(x)"] == 0.0 and row["max(x)"] == 198.0
+    desc = df.describe().to_pandas().set_index("summary")
+    # values are strings (Spark describe parity: one column holds mixed
+    # int/float statistics without float64 rounding of big ints)
+    assert desc.loc["count", "id"] == "100"
+    assert float(desc.loc["mean", "id"]) == pytest.approx(49.5)
+    assert float(desc.loc["stddev", "id"]) == pytest.approx(
+        np.arange(100).std(ddof=1)
+    )
+    assert float(desc.loc["min", "x"]) == 0.0
+    assert float(desc.loc["max", "x"]) == 198.0
 
 
 def test_function_coverage(session):
@@ -750,3 +756,42 @@ def test_substring_spark_semantics(session):
     assert out["mid"].tolist() == ["el", "b", ""]
     # negative start with short length: 4th-from-end, take 2 → "el"
     assert out["neg_short"].tolist()[0] == "el"
+
+
+def test_explode_split_describe(session):
+    """Spark-parity explode/split/describe: split produces list columns,
+    explode flattens them (dropping null/empty lists), describe returns the
+    summary-row frame."""
+    pdf = pd.DataFrame(
+        {
+            "id": [1, 2, 3, 4],
+            "words": ["a b c", "d", "", None],
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=2)
+    out = (
+        df.with_column("w", F.split("words", " "))
+        .explode("w")
+        .select("id", "w")
+        .to_pandas()
+        .sort_values(["id", "w"])
+        .reset_index(drop=True)
+    )
+    # "" splits to [""] (one element), None drops entirely
+    assert list(zip(out["id"], out["w"])) == [
+        (1, "a"), (1, "b"), (1, "c"), (2, "d"), (3, ""),
+    ]
+
+    num = session.from_pandas(
+        pd.DataFrame({"x": [1.0, 2.0, 3.0, 4.0], "s": list("abcd")}),
+        num_partitions=2,
+    )
+    desc = num.describe().to_pandas().set_index("summary")
+    assert desc.loc["count", "x"] == "4"
+    assert float(desc.loc["mean", "x"]) == pytest.approx(2.5)
+    assert float(desc.loc["stddev", "x"]) == pytest.approx(
+        pd.Series([1.0, 2.0, 3.0, 4.0]).std()
+    )
+    assert float(desc.loc["min", "x"]) == 1.0
+    assert float(desc.loc["max", "x"]) == 4.0
+    assert "s" not in desc.columns  # non-numeric excluded by default
