@@ -427,10 +427,14 @@ class DataFrame:
         row = self.agg(*aggs).collect()[0]
         # values are STRINGS, like Spark's describe: one pandas column holds
         # five mixed statistics, and float64 coercion would silently round
-        # int64 count/min/max beyond 2^53
+        # int64 count/min/max beyond 2^53. The label column dodges a data
+        # column literally named "summary" (dict-merge would overwrite it).
+        label_col = "summary"
+        while label_col in numeric:
+            label_col += "_"
         pdf = pd.DataFrame(
             {
-                "summary": [stat for stat, _ in stat_aggs],
+                label_col: [stat for stat, _ in stat_aggs],
                 **{
                     c: [
                         None
@@ -502,6 +506,13 @@ class GroupedData:
         self._df = df
         self._keys = keys
 
+    def pivot(self, column: str, values: Optional[Sequence] = None) -> "PivotedData":
+        """Spark ``pivot``: the subsequent ``.agg`` spreads ``column``'s
+        values into output columns. ``values=None`` discovers the distinct
+        values with an extra query (capped like Spark's
+        spark.sql.pivotMaxValues)."""
+        return PivotedData(self._df, self._keys, column, values)
+
     def agg(self, *aggs, **named) -> DataFrame:
         resolved: List[AggExpr] = []
         for a in aggs:
@@ -552,3 +563,79 @@ class GroupedData:
         from raydp_tpu.etl import functions as F
 
         return self.agg(*[F.max(c) for c in cols])
+
+
+class PivotedData:
+    """group_by(keys).pivot(col).agg(...) — Spark pivot semantics: the
+    aggregation runs DISTRIBUTED over (keys + pivot column), and only the
+    already-aggregated result (#key-combos × #pivot-values rows) is
+    reshaped wide on the driver, exactly the size Spark's own pivot
+    collects into its literal column list."""
+
+    MAX_VALUES = 10_000  # parity: spark.sql.pivotMaxValues default
+
+    def __init__(self, df: DataFrame, keys: List[str], column: str,
+                 values: Optional[Sequence]):
+        self._df = df
+        self._keys = keys
+        self._column = column
+        self._values = list(values) if values is not None else None
+
+    def agg(self, *aggs, **named) -> DataFrame:
+        import pandas as pd
+
+        values = self._values
+        if values is None:
+            distinct = (
+                self._df.select(self._column).distinct().collect()
+            )
+            values = sorted(
+                (r[self._column] for r in distinct),
+                key=lambda v: (v is None, str(v)),
+            )
+            if len(values) > self.MAX_VALUES:
+                raise ValueError(
+                    f"pivot column {self._column!r} has {len(values)} "
+                    f"distinct values (cap {self.MAX_VALUES}); pass an "
+                    "explicit values=[...] list"
+                )
+        inner = GroupedData(self._df, self._keys + [self._column]).agg(
+            *aggs, **named
+        )
+        pdf = inner.to_pandas()
+        agg_cols = [c for c in pdf.columns if c not in self._keys + [self._column]]
+        single = len(agg_cols) == 1
+
+        # wide frame built BY HAND (not pivot_table): explicit values with
+        # no matching rows become all-null columns instead of disappearing,
+        # null pivot values become a "null" column (Spark naming), and the
+        # keyless (global pivot) case yields one row
+        def _colname(v, a):
+            base = "null" if v is None else str(v)
+            return base if single else f"{base}_{a}"
+
+        if self._keys:
+            wide = pdf[self._keys].drop_duplicates().reset_index(drop=True)
+        else:
+            wide = pd.DataFrame(index=[0])
+        for v in values:
+            mask = (
+                pdf[self._column].isna()
+                if v is None
+                else pdf[self._column] == v
+            )
+            sub = pdf[mask]
+            if self._keys:
+                # pandas merge matches null keys to null keys, so null-key
+                # GROUPS survive the reshape too
+                merged = wide[self._keys].merge(
+                    sub[self._keys + agg_cols], on=self._keys, how="left"
+                )
+                for a in agg_cols:
+                    wide[_colname(v, a)] = merged[a].to_numpy()
+            else:
+                for a in agg_cols:
+                    wide[_colname(v, a)] = (
+                        [sub[a].iloc[0]] if len(sub) else [None]
+                    )
+        return self._df._session.from_pandas(wide, num_partitions=1)

@@ -795,3 +795,61 @@ def test_explode_split_describe(session):
     assert float(desc.loc["min", "x"]) == 1.0
     assert float(desc.loc["max", "x"]) == 4.0
     assert "s" not in desc.columns  # non-numeric excluded by default
+
+
+def test_pivot(session):
+    """group_by().pivot().agg(): distributed aggregation over
+    (keys, pivot), wide reshape with Spark naming; explicit AND discovered
+    value lists; missing combinations are null."""
+    pdf = pd.DataFrame(
+        {
+            "year": [2020, 2020, 2021, 2021, 2021],
+            "month": ["jan", "feb", "jan", "jan", "mar"],
+            "v": [1.0, 2.0, 3.0, 4.0, 5.0],
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=3)
+    out = (
+        df.group_by("year").pivot("month").agg(F.sum("v"))
+        .to_pandas().sort_values("year").reset_index(drop=True)
+    )
+    assert list(out.columns) == ["year", "feb", "jan", "mar"]  # sorted values
+    assert out.loc[0, "jan"] == 1.0 and out.loc[0, "feb"] == 2.0
+    assert out.loc[1, "jan"] == 7.0 and out.loc[1, "mar"] == 5.0
+    assert pd.isna(out.loc[1, "feb"])  # missing combo → null
+
+    # explicit values pin order and subset
+    out2 = (
+        df.group_by("year").pivot("month", values=["jan", "mar"]).agg(F.sum("v"))
+        .to_pandas().sort_values("year").reset_index(drop=True)
+    )
+    assert list(out2.columns) == ["year", "jan", "mar"]
+
+    # multiple aggregates → value_aggname columns
+    out3 = (
+        df.group_by("year").pivot("month", values=["jan"])
+        .agg(F.sum("v"), F.count("v"))
+        .to_pandas()
+    )
+    assert "jan_sum(v)" in out3.columns and "jan_count(v)" in out3.columns
+
+
+def test_pivot_edges(session):
+    """Pivot edge cases: keyless (global) pivot, explicit values absent
+    from the data (all-null column survives), and null pivot values
+    (Spark's "null" column)."""
+    pdf = pd.DataFrame(
+        {"m": ["jan", "feb", None, "jan"], "v": [1.0, 2.0, 3.0, 4.0]}
+    )
+    df = session.from_pandas(pdf, num_partitions=2)
+
+    g = df.group_by().pivot("m").agg(F.sum("v")).to_pandas()
+    assert list(g.columns) == ["feb", "jan", "null"]
+    assert g.loc[0, "jan"] == 5.0 and g.loc[0, "null"] == 3.0
+
+    e = (
+        df.group_by().pivot("m", values=["jan", "dec"]).agg(F.sum("v"))
+        .to_pandas()
+    )
+    assert list(e.columns) == ["jan", "dec"]
+    assert pd.isna(e.loc[0, "dec"])  # absent value → null column, not drop
