@@ -74,6 +74,13 @@ class NodeAgent:
         # newer worker died would resurrect a fenced-out incarnation as a
         # leaked live process nothing will ever kill.
         self.incarnation_floor: Dict[str, int] = {}
+        # floor entries outlive the children table only for the stale-
+        # delivery window; after this grace period with no respawn the entry
+        # is pruned (an agent under actor churn must not grow one floor per
+        # actor id ever spawned, forever). Scheduled when a death report
+        # removes the children entry; cancelled by a fresh spawn.
+        self.FLOOR_PRUNE_GRACE_S = 600.0
+        self._floor_prune_at: Dict[str, float] = {}
         self.lock = threading.RLock()
         self.stopping = False
         self.addr: Optional[str] = None
@@ -152,6 +159,7 @@ class NodeAgent:
                     pass
             self.children[spec.actor_id] = _ChildProc(proc, incarnation)
             self.incarnation_floor[spec.actor_id] = incarnation
+            self._floor_prune_at.pop(spec.actor_id, None)  # live again
             self.stats["spawned"] += 1
         return True
 
@@ -252,9 +260,22 @@ class NodeAgent:
                     current = self.children.get(actor_id)
                     if current is not None and current.incarnation == incarnation:
                         del self.children[actor_id]
+                        # keep the incarnation fence for the stale-delivery
+                        # window only; schedule its pruning
+                        self._floor_prune_at[actor_id] = (
+                            time.monotonic() + self.FLOOR_PRUNE_GRACE_S
+                        )
             now = time.monotonic()
             if now - last_ping >= 2.0:
                 last_ping = now
+                with self.lock:
+                    for actor_id in [
+                        a
+                        for a, t in self._floor_prune_at.items()
+                        if now >= t and a not in self.children
+                    ]:
+                        self._floor_prune_at.pop(actor_id, None)
+                        self.incarnation_floor.pop(actor_id, None)
                 try:
                     rpc(self.head_addr, ("ping", {}), timeout=5)
                     last_head_ok = now

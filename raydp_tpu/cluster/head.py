@@ -124,6 +124,10 @@ class Head:
         self.named: Dict[str, str] = {}  # name -> actor_id
         self.pgs: Dict[str, _PlacementGroup] = {}
         self.objects: Dict[str, _ObjectMeta] = {}
+        # staged chunks of in-flight proxied puts + per-object last-activity
+        # stamps (the TTL sweep in monitor_loop GCs abandoned uploads)
+        self._proxy_staging: Dict[str, Dict[int, bytes]] = {}
+        self._proxy_staging_ts: Dict[str, float] = {}
         self.shutting_down = False
         self._next_ip = 2
         self.tcp_addr: Optional[str] = None  # set by run_head once bound
@@ -678,14 +682,35 @@ class Head:
             )
             return True
 
+    # a proxied put whose client died between chunk RPCs and commit would
+    # otherwise pin up to the full object size in head memory forever; the
+    # monitor GCs staging entries idle longer than this (each arriving chunk
+    # refreshes the stamp, so slow-but-live uploads are never collected)
+    PROXY_STAGING_TTL_S = 300.0
+
     def handle_object_put_proxy_chunk(self, object_id: str, seq: int, payload: bytes):
         """One chunk of a large proxied put (the client chunks to stay under
         the frame cap); staged until commit."""
         with self.lock:
-            staging = getattr(self, "_proxy_staging", None)
-            if staging is None:
-                staging = self._proxy_staging = {}
-            staging.setdefault(object_id, {})[seq] = payload
+            self._proxy_staging.setdefault(object_id, {})[seq] = payload
+            self._proxy_staging_ts[object_id] = time.monotonic()
+        return True
+
+    def _gc_proxy_staging(self, now: float) -> None:
+        """Drop staged proxied-put chunks whose client went silent (lock held)."""
+        for object_id in [
+            o
+            for o, t in self._proxy_staging_ts.items()
+            if now - t > self.PROXY_STAGING_TTL_S
+        ]:
+            self._proxy_staging_ts.pop(object_id, None)
+            self._proxy_staging.pop(object_id, None)
+
+    def handle_object_put_proxy_abort(self, object_id: str):
+        """Client-initiated cleanup of a partially staged proxied put."""
+        with self.lock:
+            self._proxy_staging.pop(object_id, None)
+            self._proxy_staging_ts.pop(object_id, None)
         return True
 
     def handle_object_put_proxy_commit(
@@ -693,8 +718,8 @@ class Head:
         storage: str = "auto",
     ):
         with self.lock:
-            staging = getattr(self, "_proxy_staging", {})
-            chunks = staging.pop(object_id, {})
+            chunks = self._proxy_staging.pop(object_id, {})
+            self._proxy_staging_ts.pop(object_id, None)
         if len(chunks) != total_chunks:
             raise ClusterError(
                 f"proxied put {object_id}: {len(chunks)}/{total_chunks} "
@@ -905,6 +930,8 @@ class Head:
             if now - last_zygote_check > 2.0:
                 last_zygote_check = now
                 self._ensure_zygote()
+                with self.lock:
+                    self._gc_proxy_staging(now)
             # driver liveness: tear everything down if the driver is gone
             if self.driver_pid and not _pid_alive(self.driver_pid):
                 self.handle_shutdown()

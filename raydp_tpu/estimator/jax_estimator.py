@@ -1204,31 +1204,39 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             )
         )
         if scannable:
+            from raydp_tpu.exchange.jax_io import _mesh_single_device
+
             feats, labs = source.features, source.labels
             n = len(feats)
             steps = n // batch_size
             if steps:
+                device = _mesh_single_device(mesh)
                 cached = getattr(self, "_eval_device_stage", None)
                 if (
                     cached is not None
                     and cached[0] is source
                     and cached[1] == batch_size  # reshape depends on it
+                    and cached[2] == device  # arrays committed to the OLD
+                    # device must not be reused after a mesh change (mirrors
+                    # the train-side _device_stage check)
                 ):
-                    xb, yb = cached[2], cached[3]
+                    xb, yb = cached[3], cached[4]
                 else:
-                    xb = jnp.asarray(
-                        feats[: steps * batch_size].reshape(
-                            steps, batch_size, feats.shape[1]
-                        )
+                    xb = feats[: steps * batch_size].reshape(
+                        steps, batch_size, feats.shape[1]
                     )
-                    yb = jnp.asarray(
-                        labs[: steps * batch_size].reshape(
-                            (steps, batch_size) + labs.shape[1:]
-                        )
+                    yb = labs[: steps * batch_size].reshape(
+                        (steps, batch_size) + labs.shape[1:]
                     )
+                    if device != jax.devices()[0]:
+                        xb = jax.device_put(xb, device)
+                        yb = jax.device_put(yb, device)
+                    else:
+                        xb = jnp.asarray(xb)
+                        yb = jnp.asarray(yb)
                     # one slot, like the train-set device cache: per-epoch
                     # eval must not re-upload the eval set every epoch
-                    self._eval_device_stage = (source, batch_size, xb, yb)
+                    self._eval_device_stage = (source, batch_size, device, xb, yb)
                 mstate, loss_sum, count = eval_scan(params, mstate, xb, yb)
             if n % batch_size:
                 tail_x = jnp.asarray(feats[steps * batch_size :])

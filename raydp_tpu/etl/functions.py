@@ -307,10 +307,22 @@ def replace(c: ColumnLike, pattern: str, replacement: str) -> Expr:
 def regexp_replace(c: ColumnLike, pattern: str, replacement: str) -> Expr:
     """Regex replacement with Spark's ``$N`` capture-group syntax (arrow's
     RE2 backend natively uses ``\\N``; ``$N`` references are translated so
-    Spark workloads port unchanged)."""
+    Spark workloads port unchanged). Spark/Java treat ``\\$`` as an escaped
+    literal dollar — honored here: ``\\$1`` comes out as the text "$1", not
+    a capture reference."""
     import re as _re
 
-    replacement = _re.sub(r"\$(\d+)", r"\\\1", replacement)
+    def _tr(m):
+        if m.group(2) is not None:  # unescaped $N → RE2's \N
+            return "\\" + m.group(2)
+        ch = m.group(1)  # \x → literal x for ANY x (Java semantics);
+        # backslash is the one char special to RE2 rewrites — re-escape it
+        return "\\\\" if ch == "\\" else ch
+
+    # left-to-right escape scan, like Java's Matcher.replaceAll: \x is
+    # consumed as an escape before $N references are recognized (so \$1 is
+    # the text "$1" and \2 is the text "2", never a capture reference)
+    replacement = _re.sub(r"\\(.)|\$(\d+)", _tr, replacement, flags=_re.DOTALL)
     return Function(
         "replace_substring_regex", [_c(c)],
         options={"pattern": pattern, "replacement": replacement},

@@ -470,6 +470,11 @@ def partial_agg(table: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> pa
     return pa.Table.from_arrays(arrays, names=names)
 
 
+# shared sentinel standing in for float NaN inside group-key tuples (NaN is
+# unusable as a dict key: distinct NaN objects hash by id and compare unequal)
+_NAN_KEY = object()
+
+
 def _moment_between_terms(
     partials: pa.Table, merged: pa.Table, keys: List[str],
     aggs: Sequence[AggExpr],
@@ -489,7 +494,14 @@ def _moment_between_terms(
         if not keys:
             return [()] * table.num_rows
         cols = [table.column(k).to_pylist() for k in keys]
-        return list(zip(*cols)) if table.num_rows else []
+        # Canonicalize float NaN: Python hashes each NaN object by identity
+        # (and NaN != NaN), so tuple keys containing NaN from two to_pylist()
+        # calls would never match in the dict below even though arrow's
+        # group_by merged them into one group.
+        return [
+            tuple(_NAN_KEY if isinstance(v, float) and v != v else v for v in row)
+            for row in (zip(*cols) if table.num_rows else [])
+        ]
 
     merged_pos = {t: j for j, t in enumerate(_key_rows(merged))}
     partial_keys = _key_rows(partials)

@@ -369,22 +369,33 @@ def _proxy_put(
         return
     view = memoryview(payload)
     total = -(-len(payload) // _PROXY_CHUNK)
-    for seq in range(total):
+    try:
+        for seq in range(total):
+            cluster_api.head_rpc(
+                "object_put_proxy_chunk",
+                object_id=object_id,
+                seq=seq,
+                payload=bytes(view[seq * _PROXY_CHUNK : (seq + 1) * _PROXY_CHUNK]),
+                timeout=120.0,
+            )
         cluster_api.head_rpc(
-            "object_put_proxy_chunk",
+            "object_put_proxy_commit",
             object_id=object_id,
-            seq=seq,
-            payload=bytes(view[seq * _PROXY_CHUNK : (seq + 1) * _PROXY_CHUNK]),
+            owner=owner,
+            total_chunks=total,
+            storage=storage,
             timeout=120.0,
         )
-    cluster_api.head_rpc(
-        "object_put_proxy_commit",
-        object_id=object_id,
-        owner=owner,
-        total_chunks=total,
-        storage=storage,
-        timeout=120.0,
-    )
+    except BaseException:
+        # a failed multi-chunk upload must not pin its partial chunks in head
+        # memory until the TTL sweep; best-effort — the head GCs stragglers
+        try:
+            cluster_api.head_rpc(
+                "object_put_proxy_abort", object_id=object_id, timeout=5.0
+            )
+        except Exception:
+            pass
+        raise
 
 
 class _ProxyBlock:

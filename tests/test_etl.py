@@ -698,6 +698,53 @@ def test_function_spark_edge_semantics(session):
     assert out["rr"].tolist() == ["a!bcdef", "a!"]
 
 
+def test_regexp_replace_escaped_dollar(session):
+    """Spark/Java: ``\\$`` in the replacement is a LITERAL dollar, not a
+    capture reference; ``\\\\`` is a literal backslash. Escapes are consumed
+    left-to-right before $N references are recognized."""
+    pdf = pd.DataFrame({"s": ["abc"]})
+    df = session.from_pandas(pdf, num_partitions=1)
+    out = (
+        df.with_column("lit", F.regexp_replace("s", "(a)", "\\$1"))
+        .with_column("mix", F.regexp_replace("s", "(a)", "\\$$1"))
+        .with_column("bs", F.regexp_replace("s", "(a)", "\\\\$1"))
+        .with_column("dig", F.regexp_replace("s", "(a)(b)", "\\2"))
+        .to_pandas()
+    )
+    assert out["lit"].tolist() == ["$1bc"]   # escaped: literal "$1"
+    assert out["mix"].tolist() == ["$abc"]   # literal $ then group 1
+    assert out["bs"].tolist() == ["\\abc"]   # literal backslash then group 1
+    assert out["dig"].tolist() == ["2c"]     # \2 is the text "2", not group 2
+
+
+def test_grouped_stddev_nan_key(session):
+    """A float group key containing NaN must aggregate, not KeyError: the
+    moment-merge's tuple-key lookup canonicalizes NaN (Python hashes each
+    NaN object by id, so raw tuples from two to_pylist() calls never match)."""
+    pdf = pd.DataFrame(
+        {
+            "k": [1.0, np.nan, 1.0, np.nan, np.nan, 2.0] * 4,
+            "v": np.arange(24, dtype=np.float64),
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=3)
+    out = df.group_by("k").agg(F.stddev("v"), F.variance("v")).to_pandas()
+    # pandas drops NaN groups by default; compare with dropna=False
+    exp = pdf.groupby("k", dropna=False)["v"].agg(["std", "var"])
+    for k, row in exp.iterrows():
+        if k != k:  # NaN key row
+            got = out[out["k"].isna()]
+        else:
+            got = out[out["k"] == k]
+        assert len(got) == 1
+        np.testing.assert_allclose(
+            got["stddev(v)"].iloc[0], row["std"], rtol=1e-9
+        )
+        np.testing.assert_allclose(
+            got["var_samp(v)"].iloc[0], row["var"], rtol=1e-9
+        )
+
+
 def test_variance_numerically_stable(session):
     """Large-mean/small-variance data: the naive Σx² − (Σx)²/n identity
     cancels catastrophically in f64 (returns 0); the Chan-style partial
