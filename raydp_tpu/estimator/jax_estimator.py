@@ -100,12 +100,20 @@ def partial_jit(donate_argnums=()):
     return wrap
 
 
+# feature containers (one array, or a tuple of arrays in the mixed-dtype
+# path): the ONE shared convention lives in exchange/features.py
+from raydp_tpu.exchange.features import f0 as _f0
+from raydp_tpu.exchange.features import f_nbytes as _f_nbytes
+from raydp_tpu.exchange.features import f_stack as _f_stack
+from raydp_tpu.exchange.features import fmap as _fmap
+
+
 def _put_stacked_batch(mesh, arr):
     """Upload recipe shared by the scan and stream runners — delegates to
     the exchange layer's one implementation of the placement rules."""
     from raydp_tpu.exchange.jax_io import device_put_stacked
 
-    return device_put_stacked(arr, mesh)
+    return _fmap(lambda a: device_put_stacked(a, mesh), arr)
 
 
 def _scan_over_batches(step_impl, params, opt_state, xb, yb):
@@ -127,21 +135,22 @@ def _scan_over_batches(step_impl, params, opt_state, xb, yb):
 
 
 class _HostArrays:
-    """Staged (features, labels) host arrays; epochs reshuffle indices only."""
+    """Staged (features, labels) host arrays; epochs reshuffle indices only.
+    ``features`` is one array or a tuple of arrays (mixed-dtype path)."""
 
-    def __init__(self, features: np.ndarray, labels: Optional[np.ndarray]):
+    def __init__(self, features, labels: Optional[np.ndarray]):
         self.features = features
         self.labels = labels
 
     def iter(self, batch_size: int, shuffle: bool, seed: Optional[int]):
-        n = len(self.features)
+        n = len(_f0(self.features))
         order = np.arange(n)
         if shuffle:
             np.random.default_rng(seed).shuffle(order)
         stop = (n // batch_size) * batch_size  # static shapes: drop last
         for start in range(0, stop, batch_size):
             idx = order[start : start + batch_size]
-            yield self.features[idx], (
+            yield _fmap(lambda a: a[idx], self.features), (
                 self.labels[idx] if self.labels is not None else None
             )
 
@@ -166,6 +175,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         loss: Union[str, Callable] = "mse",
         metrics: Optional[Sequence[str]] = None,
         feature_columns: Optional[Sequence[str]] = None,
+        categorical_columns: Optional[Sequence[str]] = None,
         label_column: Optional[str] = None,
         batch_size: int = 64,
         num_epochs: int = 10,
@@ -175,6 +185,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         seed: int = 0,
         checkpoint_dir: Optional[str] = None,
         feature_dtype=np.float32,
+        categorical_dtype=np.int32,
         label_dtype=np.float32,
         param_sharding_rules: Optional[Callable] = None,
         donate_state: bool = True,
@@ -193,6 +204,32 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         self._loss_arg = loss
         self._metrics = Metrics(metrics)
         self.feature_columns = list(feature_columns or [])
+        # mixed-dtype staging (DLRM/Criteo): the named subset of
+        # feature_columns is staged as a SECOND array in categorical_dtype
+        # (int32 by default) and the model receives (dense, ids) — integer
+        # ids stay exact at ANY vocab size (a single float32 matrix silently
+        # collapses ids beyond 2^24; float64 staging doubles the H2D bytes).
+        # Reference examples/pytorch_dlrm.ipynb feeds int64 ids through
+        # torch tensors; this is the jax-native equivalent.
+        self.categorical_columns = list(categorical_columns or [])
+        unknown = [
+            c for c in self.categorical_columns if c not in (feature_columns or [])
+        ]
+        if unknown:
+            raise ValueError(
+                f"categorical_columns {unknown} not in feature_columns"
+            )
+        if self.categorical_columns and not np.issubdtype(
+            np.dtype(categorical_dtype), np.integer
+        ):
+            # a float categorical_dtype would silently reintroduce the id-
+            # collision class this path exists to eliminate (floats are exact
+            # only to 2^mantissa)
+            raise ValueError(
+                f"categorical_dtype must be an integer dtype, got "
+                f"{np.dtype(categorical_dtype)}"
+            )
+        self.categorical_dtype = categorical_dtype
         self.label_column = label_column
         self.batch_size = batch_size
         self.num_epochs = num_epochs
@@ -289,6 +326,22 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         devices = jax.devices()
         return Mesh(np.array(devices), ("data",))
 
+    def _feature_groups(self):
+        """None, or the ``[(dense_cols, feature_dtype), (cat_cols,
+        categorical_dtype)]`` staging spec when categorical columns are
+        configured — features then flow as a (dense, ids) tuple end to end.
+        An all-categorical model drops the empty dense group (features are
+        then a 1-tuple of the id matrix)."""
+        if not self.categorical_columns:
+            return None
+        cat_set = set(self.categorical_columns)
+        dense = [c for c in self.feature_columns if c not in cat_set]
+        groups = []
+        if dense:
+            groups.append((dense, self.feature_dtype))
+        groups.append((list(self.categorical_columns), self.categorical_dtype))
+        return groups
+
     def _effective_batch(self, mesh) -> int:
         """Round the batch up to a multiple of the data axis so every device
         gets an equal static shard."""
@@ -317,8 +370,10 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             getattr(ds, "uuid", None),
             tuple(getattr(b, "object_id", id(b)) for b in getattr(ds, "blocks", [])),
             tuple(self.feature_columns),
+            tuple(self.categorical_columns),
             self.label_column,
             np.dtype(self.feature_dtype).str,
+            np.dtype(self.categorical_dtype).str,
             np.dtype(self.label_dtype).str,
             jax.process_index(),
             jax.process_count(),
@@ -332,21 +387,27 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             staged = cache.pop(key)
             cache[key] = staged
             return staged
-        features, labels = ds.to_numpy(
-            self.feature_columns,
-            self.label_column,
-            feature_dtype=self.feature_dtype,
-            label_dtype=self.label_dtype,
-        )
+        groups = self._feature_groups()
+        if groups is not None:
+            features, labels = ds.to_numpy_grouped(
+                groups, self.label_column, label_dtype=self.label_dtype
+            )
+        else:
+            features, labels = ds.to_numpy(
+                self.feature_columns,
+                self.label_column,
+                feature_dtype=self.feature_dtype,
+                label_dtype=self.label_dtype,
+            )
         p = jax.process_count()
         if p > 1:
             # slice this process's equal share in memory (no object-store
             # round trip); wraparound oversampling keeps counts identical so
             # every process runs the same step count
-            n = len(features)
+            n = len(_f0(features))
             per = -(-n // p)
             idx = (np.arange(per) + jax.process_index() * per) % n
-            features = features[idx]
+            features = _fmap(lambda a: a[idx], features)
             labels = labels[idx] if labels is not None else None
         staged = _HostArrays(features, labels)
         while len(cache) >= 4:  # bounded: train + eval + headroom
@@ -441,32 +502,44 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             # plans — nothing is materialized here). The init sample comes
             # straight from the first non-empty block: shapes are all that
             # matter, and this avoids spinning up a producer thread.
-            from raydp_tpu.exchange.dataset import _table_to_numpy
+            from raydp_tpu.exchange.dataset import (
+                _table_to_numpy,
+                _table_to_numpy_grouped,
+            )
 
             if train_ds.count() == 0:
                 raise ValueError("streaming fit on an empty dataset")
             train_source = train_ds
             eval_source = evaluate_ds
             first = next(i for i, c in enumerate(train_ds.counts) if c > 0)
-            feats, _ = _table_to_numpy(
-                train_ds.get_block(first), self.feature_columns,
-                self.label_column, self.feature_dtype, self.label_dtype,
+            groups = self._feature_groups()
+            if groups is not None:
+                feats, _ = _table_to_numpy_grouped(
+                    train_ds.get_block(first), groups,
+                    self.label_column, self.label_dtype,
+                )
+            else:
+                feats, _ = _table_to_numpy(
+                    train_ds.get_block(first), self.feature_columns,
+                    self.label_column, self.feature_dtype, self.label_dtype,
+                )
+            sample_np = _fmap(
+                lambda a: np.resize(a, (batch_size,) + a.shape[1:]), feats
             )
-            sample_np = np.resize(feats, (batch_size, feats.shape[1]))
         else:
             # Arrow → host numpy exactly once; epochs only reshuffle indices
             train_source = self._stage_host(train_ds)
             eval_source = (
                 self._stage_host(evaluate_ds) if evaluate_ds is not None else None
             )
-            sample_np = train_source.features[:batch_size]
+            sample_np = _fmap(lambda a: a[:batch_size], train_source.features)
 
         enable_persistent_compilation_cache()
         compile_start = time.perf_counter()
         rng = jax.random.PRNGKey(self.seed)
         # one jitted init: flax init run eagerly compiles dozens of tiny ops,
         # which costs ~0.5s EACH on cold TPU backends (measured ~30s total)
-        sample = jnp.asarray(sample_np)
+        sample = _fmap(jnp.asarray, sample_np)
         params, opt_state = jax.jit(
             lambda r, s: (lambda p: (p, tx.init(p)))(module.init(r, s))
         )(rng, sample)
@@ -817,15 +890,15 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 return False
 
             try:
-                xs: List[np.ndarray] = []
+                xs: List[Any] = []
                 ys: List[np.ndarray] = []
                 for x, y in host_iter:
-                    xs.append(np.asarray(x))
+                    xs.append(_fmap(np.asarray, x))
                     ys.append(np.asarray(y))
                     if len(xs) == seg:
                         if not _emit(
                             (
-                                _put_stacked_batch(mesh, np.stack(xs)),
+                                _put_stacked_batch(mesh, _f_stack(xs)),
                                 _put_stacked_batch(mesh, np.stack(ys)),
                             )
                         ):
@@ -834,7 +907,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 if xs:
                     if not _emit(
                         (
-                            _put_stacked_batch(mesh, np.stack(xs)),
+                            _put_stacked_batch(mesh, _f_stack(xs)),
                             _put_stacked_batch(mesh, np.stack(ys)),
                         )
                     ):
@@ -887,7 +960,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     if save_cb is not None:
                         save_cb(params, opt_state, pending_save)
                     pending_save = None
-                length = xb.shape[0]
+                length = _f0(xb).shape[0]
                 if length not in compiled:
                     t0 = time.perf_counter()
                     compiled[length] = jitted.lower(
@@ -949,16 +1022,15 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         if self.scan_epochs is False:
             return None, None
         feats, labs = train_source.features, train_source.labels
-        if labs is None or len(feats) < batch_size:
+        if labs is None or len(_f0(feats)) < batch_size:
             return None, None
         if self.scan_epochs is None:
-            if feats.nbytes + labs.nbytes > self.scan_memory_limit:
+            if _f_nbytes(feats) + labs.nbytes > self.scan_memory_limit:
                 return None, None
 
-        n = len(feats)
+        n = len(_f0(feats))
         steps_per_epoch = n // batch_size
         n_used = steps_per_epoch * batch_size
-        feat_dim = feats.shape[1]
         device_resident = (
             jax.process_count() == 1 and _mesh_device_count(mesh) == 1
         )
@@ -1000,19 +1072,24 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 xs_dev, ys_dev = cached[2], cached[3]
             else:
                 if device != jax.devices()[0]:
-                    xs_dev = jax.device_put(feats, device)
+                    xs_dev = jax.device_put(feats, device)  # pytree-ok
                     ys_dev = jax.device_put(labs, device)
                 else:
                     # default device: stay uncommitted (committed arrays
                     # force a slow executor path on some PJRT plugins — see
                     # device_put_batch)
-                    xs_dev = jnp.asarray(feats)
+                    xs_dev = _fmap(jnp.asarray, feats)
                     ys_dev = jnp.asarray(labs)
                 self._device_stage = (train_source, device, xs_dev, ys_dev)
 
             def make_gather(length):
                 def seg_gather(params, opt_state, xs, ys, perm):
-                    xb = xs[perm].reshape(length, batch_size, feat_dim)
+                    xb = _fmap(
+                        lambda a: a[perm].reshape(
+                            (length, batch_size) + a.shape[1:]
+                        ),
+                        xs,
+                    )
                     yb = ys[perm].reshape((length, batch_size) + ys.shape[1:])
                     return epoch_body(params, opt_state, xb, yb)
 
@@ -1038,7 +1115,13 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             def run_segment(params, opt_state, order, start, length):
                 sel = order[start * batch_size : (start + length) * batch_size]
                 xb = _put_stacked_batch(
-                    mesh, feats[sel].reshape(length, batch_size, feat_dim)
+                    mesh,
+                    _fmap(
+                        lambda a: a[sel].reshape(
+                            (length, batch_size) + a.shape[1:]
+                        ),
+                        feats,
+                    ),
                 )
                 yb = _put_stacked_batch(
                     mesh, labs[sel].reshape((length, batch_size) + labs.shape[1:])
@@ -1077,7 +1160,12 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 # once per epoch — this path beats it by construction.
                 def one_epoch(carry, perm):
                     p, o = carry
-                    xb = xs[perm].reshape(steps_per_epoch, batch_size, feat_dim)
+                    xb = _fmap(
+                        lambda a: a[perm].reshape(
+                            (steps_per_epoch, batch_size) + a.shape[1:]
+                        ),
+                        xs,
+                    )
                     yb = ys[perm].reshape(
                         (steps_per_epoch, batch_size) + ys.shape[1:]
                     )
@@ -1134,6 +1222,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             shuffle=shuffle, seed=seed, drop_last=True,
             feature_dtype=self.feature_dtype, label_dtype=self.label_dtype,
             streaming=True, block_plan=plan,
+            feature_groups=self._feature_groups(),
         )
 
     def _make_eval_step(self, module, loss_fn):
@@ -1156,12 +1245,12 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         def eval_step(params, mstate, loss_sum, count, x, y):
             pred = module.apply(params, x)
             mstate = metrics.update(mstate, pred, y)
-            rows = float(x.shape[0])
+            rows = float(_f0(x).shape[0])
             return mstate, loss_sum + loss_fn(pred, y) * rows, count + rows
 
         @jax.jit
         def eval_scan(params, mstate, xb, yb):
-            rows = float(xb.shape[1])
+            rows = float(_f0(xb).shape[1])
 
             def body(carry, xy):
                 ms, ls, c = carry
@@ -1199,7 +1288,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             and _mesh_device_count(mesh) == 1
             and (
                 self.scan_epochs is True
-                or source.features.nbytes + source.labels.nbytes
+                or _f_nbytes(source.features) + source.labels.nbytes
                 <= self.scan_memory_limit
             )
         )
@@ -1207,7 +1296,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             from raydp_tpu.exchange.jax_io import _mesh_single_device
 
             feats, labs = source.features, source.labels
-            n = len(feats)
+            n = len(_f0(feats))
             steps = n // batch_size
             if steps:
                 device = _mesh_single_device(mesh)
@@ -1222,24 +1311,29 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 ):
                     xb, yb = cached[3], cached[4]
                 else:
-                    xb = feats[: steps * batch_size].reshape(
-                        steps, batch_size, feats.shape[1]
+                    xb = _fmap(
+                        lambda a: a[: steps * batch_size].reshape(
+                            (steps, batch_size) + a.shape[1:]
+                        ),
+                        feats,
                     )
                     yb = labs[: steps * batch_size].reshape(
                         (steps, batch_size) + labs.shape[1:]
                     )
                     if device != jax.devices()[0]:
-                        xb = jax.device_put(xb, device)
+                        xb = jax.device_put(xb, device)  # pytree-ok
                         yb = jax.device_put(yb, device)
                     else:
-                        xb = jnp.asarray(xb)
+                        xb = _fmap(jnp.asarray, xb)
                         yb = jnp.asarray(yb)
                     # one slot, like the train-set device cache: per-epoch
                     # eval must not re-upload the eval set every epoch
                     self._eval_device_stage = (source, batch_size, device, xb, yb)
                 mstate, loss_sum, count = eval_scan(params, mstate, xb, yb)
             if n % batch_size:
-                tail_x = jnp.asarray(feats[steps * batch_size :])
+                tail_x = _fmap(
+                    lambda a: jnp.asarray(a[steps * batch_size :]), feats
+                )
                 tail_y = jnp.asarray(labs[steps * batch_size :])
                 mstate, loss_sum, count = eval_step(
                     params, mstate, loss_sum, count, tail_x, tail_y

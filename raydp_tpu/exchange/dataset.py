@@ -272,6 +272,21 @@ class Dataset:
             feature_dtype, label_dtype,
         )
 
+    def to_numpy_grouped(
+        self,
+        feature_groups: Sequence[Tuple[Sequence[str], Any]],
+        label_column: Optional[str] = None,
+        label_dtype=np.float32,
+    ) -> Tuple[Tuple[np.ndarray, ...], Optional[np.ndarray]]:
+        """Like ``to_numpy`` but stages SEVERAL feature matrices in one
+        Arrow pass, one per ``(columns, dtype)`` group — the mixed-dtype
+        path (e.g. DLRM: dense float32 + categorical ids int32, where one
+        float matrix would silently collapse ids beyond float32's exact-
+        integer range and double the H2D bytes as float64)."""
+        return _table_to_numpy_grouped(
+            self.to_arrow(), feature_groups, label_column, label_dtype
+        )
+
     def iter_batches(
         self,
         batch_size: int,
@@ -284,6 +299,7 @@ class Dataset:
         label_dtype=np.float32,
         streaming: bool = False,
         block_plan: Optional[List[Tuple[int, int, int]]] = None,
+        feature_groups: Optional[Sequence[Tuple[Sequence[str], Any]]] = None,
     ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
         """Batches of (features [B, F], labels [B]).
 
@@ -296,33 +312,50 @@ class Dataset:
         boundaries via a carryover, so batch shapes are identical to the
         staged path. ``block_plan`` (streaming only) restricts the pass to
         ``streaming_shard_plan`` spans without materializing slices.
+        ``feature_groups`` (overrides feature_columns/feature_dtype): stage
+        one matrix per (columns, dtype) group — batches yield a TUPLE of
+        feature arrays (the mixed-dtype path).
         """
         if streaming:
             return StreamingBatchIterator(
                 self, batch_size, feature_columns, label_column,
                 shuffle, seed, drop_last, feature_dtype, label_dtype,
-                block_plan=block_plan,
+                block_plan=block_plan, feature_groups=feature_groups,
             )
         return self._iter_batches_staged(
             batch_size, feature_columns, label_column, shuffle, seed,
-            drop_last, feature_dtype, label_dtype,
+            drop_last, feature_dtype, label_dtype, feature_groups,
         )
 
     def _iter_batches_staged(
         self, batch_size, feature_columns, label_column, shuffle, seed,
-        drop_last, feature_dtype, label_dtype,
+        drop_last, feature_dtype, label_dtype, feature_groups=None,
     ):
-        features, labels = self.to_numpy(
-            feature_columns, label_column, feature_dtype, label_dtype
-        )
-        n = len(features)
+        if feature_groups is not None:
+            features, labels = self.to_numpy_grouped(
+                feature_groups, label_column, label_dtype
+            )
+            first = features[0]
+        else:
+            features, labels = self.to_numpy(
+                feature_columns, label_column, feature_dtype, label_dtype
+            )
+            first = features
+        n = len(first)
         order = np.arange(n)
         if shuffle:
             np.random.default_rng(seed).shuffle(order)
         stop = (n // batch_size) * batch_size if drop_last else n
         for start in range(0, stop, batch_size):
             idx = order[start : start + batch_size]
-            yield features[idx], (labels[idx] if labels is not None else None)
+            if feature_groups is not None:
+                yield tuple(g[idx] for g in features), (
+                    labels[idx] if labels is not None else None
+                )
+            else:
+                yield features[idx], (
+                    labels[idx] if labels is not None else None
+                )
 
     def to_torch(
         self,
@@ -376,11 +409,42 @@ def _table_to_numpy(
     feature_dtype,
     label_dtype,
 ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
-    cols = [
-        table.column(c).combine_chunks().to_numpy(zero_copy_only=False)
-        for c in feature_columns
-    ]
-    features = np.stack(cols, axis=1).astype(feature_dtype)
+    """Single-matrix staging — the one-group case of the grouped path."""
+    features, labels = _table_to_numpy_grouped(
+        table, [(feature_columns, feature_dtype)], label_column, label_dtype
+    )
+    return features[0], labels
+
+
+def _table_to_numpy_grouped(
+    table: pa.Table,
+    feature_groups: Sequence[Tuple[Sequence[str], Any]],
+    label_column: Optional[str],
+    label_dtype,
+) -> Tuple[Tuple[np.ndarray, ...], Optional[np.ndarray]]:
+    """One matrix per (columns, dtype) group, staged from ONE arrow table
+    pass — the mixed-dtype feeding path (dense floats + integer ids)."""
+
+    def _col(c, dtype):
+        arr = table.column(c).combine_chunks().to_numpy(zero_copy_only=False)
+        if np.issubdtype(np.dtype(dtype), np.integer) and np.issubdtype(
+            arr.dtype, np.floating
+        ):
+            # arrow surfaces nullable int columns as float64+NaN; a silent
+            # astype would turn NaN (or inf) into INT_MIN and gather-clamp
+            # every such row onto embedding 0 — fail loudly instead
+            if not np.isfinite(arr).all():
+                raise ValueError(
+                    f"column {c!r} contains nulls or non-finite values and "
+                    f"cannot stage as {np.dtype(dtype)}; fill or drop them "
+                    "in ETL first"
+                )
+        return arr
+
+    features = tuple(
+        np.stack([_col(c, dtype) for c in cols], axis=1).astype(dtype)
+        for cols, dtype in feature_groups
+    )
     labels = None
     if label_column is not None:
         labels = (
@@ -443,6 +507,7 @@ class StreamingBatchIterator:
         shuffle: bool, seed: Optional[int], drop_last: bool,
         feature_dtype, label_dtype,
         block_plan: Optional[List[Tuple[int, int, int]]] = None,
+        feature_groups: Optional[Sequence[Tuple[Sequence[str], Any]]] = None,
     ):
         self._ds = ds
         self._batch_size = batch_size
@@ -454,6 +519,14 @@ class StreamingBatchIterator:
         self._feature_dtype = feature_dtype
         self._label_dtype = label_dtype
         self._block_plan = block_plan
+        # grouped mode: one matrix per (columns, dtype) group; batches yield
+        # a TUPLE of feature arrays (internally everything is a list of
+        # group parts — single-matrix mode is the 1-element case)
+        self._feature_groups = (
+            [(list(c), d) for c, d in feature_groups]
+            if feature_groups is not None
+            else None
+        )
         self._active_gen = None
         self.peak_staged_rows = 0
 
@@ -491,6 +564,8 @@ class StreamingBatchIterator:
         staged: "queue.Queue" = queue.Queue(maxsize=1)
         stop = threading.Event()
 
+        grouped = self._feature_groups is not None
+
         def producer():
             try:
                 for oi in order:
@@ -502,51 +577,65 @@ class StreamingBatchIterator:
                         table = table.slice(row_start, row_stop - row_start)
                     if table.num_rows == 0:
                         continue
-                    pair = _table_to_numpy(
-                        table, self._feature_columns,
-                        self._label_column, self._feature_dtype,
-                        self._label_dtype,
-                    )
-                    staged.put(pair)
+                    if grouped:
+                        feats, labels = _table_to_numpy_grouped(
+                            table, self._feature_groups,
+                            self._label_column, self._label_dtype,
+                        )
+                        parts = list(feats)
+                    else:
+                        f, labels = _table_to_numpy(
+                            table, self._feature_columns,
+                            self._label_column, self._feature_dtype,
+                            self._label_dtype,
+                        )
+                        parts = [f]
+                    staged.put((parts, labels))
                 staged.put(None)
             except BaseException as e:  # surface in the consumer
                 staged.put(e)
+
+        def _emit(parts, labels):
+            return (tuple(parts) if grouped else parts[0]), labels
 
         thread = threading.Thread(target=producer, daemon=True)
         thread.start()
         try:
             batch = self._batch_size
-            left_f = left_l = None
+            left_p = left_l = None
             while True:
                 item = staged.get()
                 if item is None:
                     break
                 if isinstance(item, BaseException):
                     raise item
-                feats, labels = item
+                parts, labels = item
                 if self._shuffle:
-                    perm = rng.permutation(len(feats))
-                    feats = feats[perm]
+                    perm = rng.permutation(len(parts[0]))
+                    parts = [p[perm] for p in parts]
                     labels = labels[perm] if labels is not None else None
-                if left_f is not None and len(left_f):
-                    feats = np.concatenate([left_f, feats])
+                if left_p is not None and len(left_p[0]):
+                    parts = [
+                        np.concatenate([lp, p]) for lp, p in zip(left_p, parts)
+                    ]
                     if labels is not None:
                         labels = np.concatenate([left_l, labels])
-                resident = len(feats)
+                resident = len(parts[0])
                 if staged.qsize():  # safe peek: only this thread consumes
                     head = staged.queue[0]
                     if head is not None and not isinstance(head, BaseException):
-                        resident += len(head[0])
+                        resident += len(head[0][0])
                 self.peak_staged_rows = max(self.peak_staged_rows, resident)
-                full = (len(feats) // batch) * batch
+                full = (len(parts[0]) // batch) * batch
                 for s in range(0, full, batch):
-                    yield feats[s : s + batch], (
-                        labels[s : s + batch] if labels is not None else None
+                    yield _emit(
+                        [p[s : s + batch] for p in parts],
+                        labels[s : s + batch] if labels is not None else None,
                     )
-                left_f = feats[full:]
+                left_p = [p[full:] for p in parts]
                 left_l = labels[full:] if labels is not None else None
-            if left_f is not None and len(left_f) and not self._drop_last:
-                yield left_f, left_l
+            if left_p is not None and len(left_p[0]) and not self._drop_last:
+                yield _emit(left_p, left_l)
         finally:
             stop.set()
             # unblock a producer waiting on a full queue

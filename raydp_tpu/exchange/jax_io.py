@@ -62,7 +62,9 @@ def device_put_batch(batch, mesh, axis: str = "data"):
         return jax.device_put(x, sharding)
 
     if isinstance(batch, (tuple, list)):
-        return type(batch)(_put(x) for x in batch)
+        # recurse: a batch element may itself be a tuple of arrays (the
+        # mixed-dtype (dense, ids) feature container)
+        return type(batch)(device_put_batch(x, mesh, axis) for x in batch)
     return _put(batch)
 
 

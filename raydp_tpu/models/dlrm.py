@@ -11,9 +11,14 @@ TPU-first differences from the reference:
   Gram matmul), optionally the pallas kernel;
 - bfloat16 compute path for the MXU via ``dtype=jnp.bfloat16``.
 
-Input convention (matches the estimator's single feature matrix): x[:, :num_dense]
-are float dense features; x[:, num_dense:] are categorical ids (stored as
-floats by the exchange layer, cast back to int32 here).
+Input convention — two forms:
+- preferred (the estimator's ``categorical_columns`` mixed-dtype path):
+  ``x = (dense, ids)`` with dense float [B, num_dense] and ids integer
+  [B, S] — exact at ANY vocab size (reference pytorch_dlrm.ipynb feeds
+  int64 ids through torch tensors; this is the jax-native equivalent);
+- legacy single float matrix: x[:, :num_dense] dense, x[:, num_dense:]
+  categorical ids cast back to int32 (guarded — float32 represents
+  integers exactly only up to 2^24, so big vocabs must use the tuple form).
 """
 
 from __future__ import annotations
@@ -37,24 +42,41 @@ class DLRM(nn.Module):
 
     @nn.compact
     def __call__(self, x):
-        dense = x[:, : self.num_dense].astype(self.dtype)
-        # Categorical ids may arrive through the estimator's single float
-        # feature matrix. Floats represent integers exactly only up to
-        # 2^mantissa — beyond that, distinct ids collapse onto the same
-        # embedding row silently. Trace-time guard (dtype and vocab sizes are
-        # static): require an exact representation or integer inputs.
-        if jnp.issubdtype(x.dtype, jnp.floating):
-            mantissa = jnp.finfo(x.dtype).nmant + 1
-            max_vocab = max(self.vocab_sizes)
-            # integers up to 2^mantissa INCLUSIVE are exact; max id is vocab-1
-            if max_vocab - 1 > 2**mantissa:
-                raise ValueError(
-                    f"vocab size {max_vocab} exceeds exact-integer range of "
-                    f"{x.dtype} features (2^{mantissa}); pass ids as integers "
-                    "(per-column dtypes in Dataset.to_numpy) or use float64 "
-                    "features"
-                )
-        ids = x[:, self.num_dense :].astype(jnp.int32)  # [B, S]
+        if isinstance(x, (tuple, list)):
+            # mixed-dtype input (dense, ids): ids arrive as integers — exact
+            # at any vocab size
+            dense, ids = x
+            dense = dense.astype(self.dtype)
+            if jnp.issubdtype(ids.dtype, jnp.floating):
+                # same silent-collision class the single-matrix guard blocks:
+                # float ids round before the cast hides it
+                mantissa = jnp.finfo(ids.dtype).nmant + 1
+                if max(self.vocab_sizes) - 1 > 2**mantissa:
+                    raise ValueError(
+                        f"vocab size {max(self.vocab_sizes)} exceeds exact-"
+                        f"integer range of {ids.dtype} ids (2^{mantissa}); "
+                        "pass ids as an integer array"
+                    )
+            ids = ids.astype(jnp.int32)
+        else:
+            dense = x[:, : self.num_dense].astype(self.dtype)
+            # Categorical ids may arrive through the estimator's single float
+            # feature matrix. Floats represent integers exactly only up to
+            # 2^mantissa — beyond that, distinct ids collapse onto the same
+            # embedding row silently. Trace-time guard (dtype and vocab sizes
+            # are static): require an exact representation or integer inputs.
+            if jnp.issubdtype(x.dtype, jnp.floating):
+                mantissa = jnp.finfo(x.dtype).nmant + 1
+                max_vocab = max(self.vocab_sizes)
+                # ints up to 2^mantissa INCLUSIVE are exact; max id is vocab-1
+                if max_vocab - 1 > 2**mantissa:
+                    raise ValueError(
+                        f"vocab size {max_vocab} exceeds exact-integer range "
+                        f"of {x.dtype} features (2^{mantissa}); pass ids as a "
+                        "separate integer array (JaxEstimator "
+                        "categorical_columns / x=(dense, ids))"
+                    )
+            ids = x[:, self.num_dense :].astype(jnp.int32)  # [B, S]
 
         # bottom MLP → dense embedding of dim embed_dim
         h = dense

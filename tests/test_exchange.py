@@ -92,6 +92,36 @@ def test_iter_batches_and_numpy(session):
     assert all(b[0].shape == (16, 2) for b in batches)
 
 
+def test_grouped_numpy_and_batches(session):
+    """Mixed-dtype staging: to_numpy_grouped stages one matrix per
+    (columns, dtype) group in one arrow pass; iter_batches(feature_groups=)
+    yields TUPLE features in both staged and streaming modes, identical
+    content between the two."""
+    ds = dataframe_to_dataset(_make_df(session, n=64))
+    groups = [(["x"], np.float32), (["id"], np.int32)]
+    (dense, ids), y = ds.to_numpy_grouped(groups, "x")
+    assert dense.dtype == np.float32 and dense.shape == (64, 1)
+    assert ids.dtype == np.int32 and ids.shape == (64, 1)
+    assert y is not None and y.shape == (64,)
+    np.testing.assert_array_equal(np.sort(ids[:, 0]), np.arange(64))
+
+    staged = list(
+        ds.iter_batches(16, [], "x", feature_groups=groups, drop_last=True)
+    )
+    streamed = list(
+        ds.iter_batches(
+            16, [], "x", feature_groups=groups, drop_last=True, streaming=True
+        )
+    )
+    assert len(staged) == 4 and len(streamed) == 4
+    for (sf, sy), (tf, ty) in zip(staged, streamed):
+        assert isinstance(sf, tuple) and isinstance(tf, tuple)
+        assert sf[0].dtype == np.float32 and sf[1].dtype == np.int32
+        np.testing.assert_array_equal(sy, ty)
+        np.testing.assert_array_equal(sf[1], tf[1])
+        np.testing.assert_allclose(sf[0], tf[0])
+
+
 def test_ownership_dies_with_session(session):
     """Without transfer, blocks are owned by executors and die at stop —
     reference test_fail_without_data_ownership_transfer."""
