@@ -1136,22 +1136,35 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
         def run_epoch(params, opt_state, seed, start_step=0, save_cb=None):
             order = _order(seed)
-            loss_total = jnp.zeros((), jnp.float32)
+            # the common one-segment epoch must not pay an extra scalar-add
+            # dispatch per epoch (measured 1.5ms/dispatch on tunneled PJRT —
+            # 30 epochs cost 4% of the whole DLRM fit)
+            loss_total = None
             done = start_step
             while done < steps_per_epoch:
                 length = min(seg_cap, steps_per_epoch - done)
                 params, opt_state, loss_sum = run_segment(
                     params, opt_state, order, done, length
                 )
-                loss_total = loss_total + loss_sum
+                loss_total = (
+                    loss_sum if loss_total is None else loss_total + loss_sum
+                )
                 done += length
                 # the epoch-complete checkpoint is the outer loop's epoch_N
                 if save_cb is not None and done < steps_per_epoch:
                     save_cb(params, opt_state, done)
+            if loss_total is None:
+                loss_total = jnp.zeros((), jnp.float32)
             return params, opt_state, loss_total, steps_per_epoch - start_step
 
         run_fullfit = None
-        if device_resident:
+        # Mixed-dtype (embedding-gather) workloads run FASTER as per-epoch
+        # dispatches than as one whole-fit dispatch: on v5e at the DLRM
+        # tracked config the nested epoch-scan measured 1.7-2.1M sps and a
+        # flattened single scan 2.0-2.2M, vs 2.8M for per-epoch dispatch
+        # with whole-epoch pre-gather — the outer scan defeats XLA's gather
+        # fusion. Dense models (MLP) keep the fullfit win (r4: 1.26x pure).
+        if device_resident and not isinstance(feats, tuple):
 
             def fullfit_body(params, opt_state, xs, ys, perms):
                 # outer scan over epochs of the inner per-step scan: ONE

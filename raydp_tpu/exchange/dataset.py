@@ -427,18 +427,29 @@ def _table_to_numpy_grouped(
 
     def _col(c, dtype):
         arr = table.column(c).combine_chunks().to_numpy(zero_copy_only=False)
-        if np.issubdtype(np.dtype(dtype), np.integer) and np.issubdtype(
-            arr.dtype, np.floating
-        ):
-            # arrow surfaces nullable int columns as float64+NaN; a silent
-            # astype would turn NaN (or inf) into INT_MIN and gather-clamp
-            # every such row onto embedding 0 — fail loudly instead
-            if not np.isfinite(arr).all():
-                raise ValueError(
-                    f"column {c!r} contains nulls or non-finite values and "
-                    f"cannot stage as {np.dtype(dtype)}; fill or drop them "
-                    "in ETL first"
-                )
+        target = np.dtype(dtype)
+        if np.issubdtype(target, np.integer):
+            if np.issubdtype(arr.dtype, np.floating):
+                # arrow surfaces nullable int columns as float64+NaN; a
+                # silent astype would turn NaN (or inf) into INT_MIN and
+                # gather-clamp every such row onto embedding 0 — fail loudly
+                if not np.isfinite(arr).all():
+                    raise ValueError(
+                        f"column {c!r} contains nulls or non-finite values "
+                        f"and cannot stage as {target}; fill or drop them "
+                        "in ETL first"
+                    )
+            if arr.size and np.issubdtype(arr.dtype, np.integer):
+                info = np.iinfo(target)
+                lo, hi = arr.min(), arr.max()
+                # astype wraps out-of-range ids negative — the same silent-
+                # collision class as lossy floats; demand a wider dtype
+                if lo < info.min or hi > info.max:
+                    raise ValueError(
+                        f"column {c!r} has ids outside {target} range "
+                        f"[{info.min}, {info.max}]; use a wider "
+                        "categorical_dtype (e.g. np.int64)"
+                    )
         return arr
 
     features = tuple(

@@ -40,42 +40,34 @@ class DLRM(nn.Module):
     use_pallas_interaction: Optional[bool] = None  # None = pallas on TPU
     dtype: jnp.dtype = jnp.float32
 
+    def _check_float_ids(self, dtype) -> None:
+        """Trace-time guard: floats represent integers exactly only up to
+        2^mantissa — beyond that, distinct ids silently collapse onto the
+        same embedding row (dtype and vocab sizes are static)."""
+        if not jnp.issubdtype(dtype, jnp.floating):
+            return
+        mantissa = jnp.finfo(dtype).nmant + 1
+        max_vocab = max(self.vocab_sizes)
+        # ints up to 2^mantissa INCLUSIVE are exact; max id is vocab-1
+        if max_vocab - 1 > 2**mantissa:
+            raise ValueError(
+                f"vocab size {max_vocab} exceeds exact-integer range of "
+                f"{dtype} ids (2^{mantissa}); pass ids as a separate integer "
+                "array (JaxEstimator categorical_columns / x=(dense, ids))"
+            )
+
     @nn.compact
     def __call__(self, x):
         if isinstance(x, (tuple, list)):
-            # mixed-dtype input (dense, ids): ids arrive as integers — exact
-            # at any vocab size
+            # mixed-dtype input (dense, ids): integer ids are exact at any
+            # vocab size; float ids get the same guard as the legacy path
             dense, ids = x
             dense = dense.astype(self.dtype)
-            if jnp.issubdtype(ids.dtype, jnp.floating):
-                # same silent-collision class the single-matrix guard blocks:
-                # float ids round before the cast hides it
-                mantissa = jnp.finfo(ids.dtype).nmant + 1
-                if max(self.vocab_sizes) - 1 > 2**mantissa:
-                    raise ValueError(
-                        f"vocab size {max(self.vocab_sizes)} exceeds exact-"
-                        f"integer range of {ids.dtype} ids (2^{mantissa}); "
-                        "pass ids as an integer array"
-                    )
+            self._check_float_ids(ids.dtype)
             ids = ids.astype(jnp.int32)
         else:
             dense = x[:, : self.num_dense].astype(self.dtype)
-            # Categorical ids may arrive through the estimator's single float
-            # feature matrix. Floats represent integers exactly only up to
-            # 2^mantissa — beyond that, distinct ids collapse onto the same
-            # embedding row silently. Trace-time guard (dtype and vocab sizes
-            # are static): require an exact representation or integer inputs.
-            if jnp.issubdtype(x.dtype, jnp.floating):
-                mantissa = jnp.finfo(x.dtype).nmant + 1
-                max_vocab = max(self.vocab_sizes)
-                # ints up to 2^mantissa INCLUSIVE are exact; max id is vocab-1
-                if max_vocab - 1 > 2**mantissa:
-                    raise ValueError(
-                        f"vocab size {max_vocab} exceeds exact-integer range "
-                        f"of {x.dtype} features (2^{mantissa}); pass ids as a "
-                        "separate integer array (JaxEstimator "
-                        "categorical_columns / x=(dense, ids))"
-                    )
+            self._check_float_ids(x.dtype)
             ids = x[:, self.num_dense :].astype(jnp.int32)  # [B, S]
 
         # bottom MLP → dense embedding of dim embed_dim
