@@ -106,6 +106,18 @@ def init(
         # the head (and the actors it spawns) must be able to import raydp_tpu
         # and user modules no matter where the driver was launched from
         head_env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+        # start the zygote NOW, before the head boots: its import warm-up
+        # (~0.45s) is the critical path of the first session's actor spawns,
+        # and the head's own _ensure_zygote is idempotent per marker. The
+        # zygote's parent-death watch follows this driver — acceptable: the
+        # head tears the cluster down when the driver dies anyway, and its
+        # monitor restarts a missing zygote.
+        try:
+            from raydp_tpu.cluster.common import start_zygote
+
+            start_zygote(_session_dir, env=head_env)
+        except Exception:
+            pass  # the head will start one at boot
         # -S: skip site/sitecustomize (this image's sitecustomize imports jax
         # + the TPU plugin — ~2.6s the head never needs); imports resolve via
         # the PYTHONPATH above

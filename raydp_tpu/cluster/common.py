@@ -338,7 +338,6 @@ class ZygoteProc:
                 pass  # no marker yet: the child may still be running
         try:
             os.kill(self.pid, 0)
-            return None
         except ProcessLookupError:
             self._rc = 0  # gone before the marker landed; code unknown
             return self._rc
@@ -347,6 +346,19 @@ class ZygoteProc:
             # has exited (the marker write may still be in flight)
             self._rc = 1
             return self._rc
+        # kill(pid, 0) succeeds on ZOMBIES too: the child is dead but the
+        # zygote hasn't reaped it yet (its loop cadence stretches under CPU
+        # contention — measured ~0.4s on a busy 1-core box). Read the state
+        # from /proc so death detection never waits on the reaper; the exit
+        # marker, when it lands, carries the real code for post-mortems.
+        try:
+            with open(f"/proc/{self.pid}/stat") as f:
+                if f.read().rsplit(") ", 1)[1][:1] == "Z":
+                    self._rc = 1
+                    return self._rc
+        except (OSError, IndexError):
+            pass  # no /proc (non-Linux): fall back to marker/pid semantics
+        return None
 
 
 # the zygote processes THIS process started, keyed by run_dir — kept so
@@ -355,10 +367,11 @@ class ZygoteProc:
 _zygote_procs: Dict[str, Any] = {}
 
 
-def start_zygote(run_dir: str) -> None:
+def start_zygote(run_dir: str, env: Optional[Dict[str, str]] = None) -> None:
     """Start the pre-warmed fork template for this node (idempotent per
-    marker file). Called at head/agent boot so the warm-up overlaps other
-    startup work; spawns wait on the socket, not the warm-up."""
+    marker file). Called at head/agent boot — and eagerly by cluster.init —
+    so the warm-up overlaps other startup work; spawns wait on the socket,
+    not the warm-up."""
     import subprocess
     import sys
 
@@ -371,7 +384,7 @@ def start_zygote(run_dir: str) -> None:
             [sys.executable, "-S", "-m", "raydp_tpu.cluster.zygote", run_dir],
             stdout=out,
             stderr=out,
-            env=dict(os.environ),
+            env=dict(env if env is not None else os.environ),
             start_new_session=True,
         )
     _zygote_procs[run_dir] = proc
@@ -382,7 +395,10 @@ def start_zygote(run_dir: str) -> None:
 
 def zygote_alive(run_dir: str) -> bool:
     """Is this node's zygote running? Polls (reaps) our own child; falls
-    back to a pid probe for a zygote another process started."""
+    back to a pid probe for a zygote another process started. A ZOMBIE
+    counts as dead: the eager cluster.init zygote is the DRIVER's child, so
+    after it dies the head's pid probe would otherwise see the unreaped
+    zombie as alive forever and never restart it."""
     proc = _zygote_procs.get(run_dir)
     if proc is not None:
         return proc.poll() is None
@@ -392,9 +408,13 @@ def zygote_alive(run_dir: str) -> bool:
         with open(zygote_marker_path(run_dir)) as f:
             pid = int(f.read().strip())
         os.kill(pid, 0)
-        return True
     except (OSError, ValueError):
         return False
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            return f.read().rsplit(") ", 1)[1][:1] != "Z"
+    except (OSError, IndexError):
+        return True  # no /proc: keep the plain pid-probe answer
 
 
 def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log_base: str):

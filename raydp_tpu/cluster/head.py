@@ -621,7 +621,32 @@ class Head:
             if no_restart:
                 actor.intentional_exit = True
             self._kill_proc(actor)
+            if actor.proc is not None:
+                # fast-path reap: an intentional kill is otherwise only
+                # noticed by the 50ms monitor cadence — session stop drains
+                # on DEAD state, so observe the SIGKILL promptly off-lock
+                threading.Thread(
+                    target=self._reap_after_kill, args=(actor,), daemon=True
+                ).start()
             return True
+
+    def _reap_after_kill(self, actor: "_Actor") -> None:
+        """Wait (bounded) for a just-SIGKILLed local actor to exit, then run
+        the death bookkeeping immediately instead of on the next monitor
+        poll. Racing the monitor is safe: both transition under the lock and
+        skip actors already DEAD."""
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            if actor.proc is None or actor.proc.poll() is not None:
+                with self.lock:
+                    if (
+                        actor.state != ActorState.DEAD
+                        and not actor.pending_respawn
+                        and (actor.proc is None or actor.proc.poll() is not None)
+                    ):
+                        self._on_actor_death(actor)
+                return
+            time.sleep(0.005)
 
     def _release_actor_resources(self, actor: _Actor) -> None:
         spec = actor.spec
@@ -1079,11 +1104,15 @@ def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, flo
     # inherit it too
     os.environ[TOKEN_ENV] = token.hex()
     # pre-warmed fork template: light-actor spawns become ~10ms forks instead
-    # of ~450ms interpreter+pyarrow starts (its warm-up overlaps boot)
-    from raydp_tpu.cluster.common import start_zygote
+    # of ~450ms interpreter+pyarrow starts. cluster.init usually started one
+    # EAGERLY before this head booted (its warm-up is the first session's
+    # critical path) — a second one here would rebind the socket over it and
+    # double the import work
+    from raydp_tpu.cluster.common import start_zygote, zygote_alive
 
     try:
-        start_zygote(session_dir)
+        if not zygote_alive(session_dir):
+            start_zygote(session_dir)
     except Exception:
         pass  # spawns fall back to cold subprocess starts
     head.tcp_addr = f"tcp://{_advertised_ip()}:{tcp_server.server_address[1]}"

@@ -44,16 +44,27 @@ def zygote_marker_path(run_dir: str) -> str:
 
 
 def _warm_imports() -> None:
-    """Import what (nearly) every light actor needs. Failures are tolerated:
-    a zygote without pyarrow still serves forks, children just import lazily."""
+    """Import what (nearly) every light actor needs BEFORE binding the fork
+    socket. pandas belongs here even though the worker ready path never
+    touches it: pyarrow's first pa.array/pa.scalar resolves its lazy
+    pandas-compat shim by importing pandas (~0.35s), so any child forked
+    without it pays that on its FIRST TASK — once per child instead of once
+    per zygote. Failures are tolerated: a zygote without pyarrow still
+    serves forks, children just import lazily."""
     import cloudpickle  # noqa: F401
     import raydp_tpu.cluster.worker  # noqa: F401
 
     try:
         import numpy  # noqa: F401
-        import pandas  # noqa: F401  (hash/shuffle kernels + to_pandas paths)
+        import pandas  # noqa: F401  (pyarrow's pa.array imports it anyway)
         import pyarrow  # noqa: F401
         import pyarrow.compute  # noqa: F401
+
+        import pyarrow as _pa
+
+        # resolve the pandas-compat shim NOW: pa.array/pa.scalar do this
+        # lazily on first use, and children should inherit it resolved
+        _pa.array([0])
 
         import raydp_tpu.etl.executor  # noqa: F401
         import raydp_tpu.etl.tasks  # noqa: F401
@@ -110,12 +121,39 @@ def _become_worker(req: dict, conn: socket.socket) -> None:
         os._exit(0)
 
 
+def _serve_one(children: dict) -> bool:
+    """Accept and serve one fork request; False on accept timeout."""
+    from raydp_tpu.cluster.common import recv_frame, send_frame
+
+    try:
+        conn, _ = _listener.accept()
+    except socket.timeout:
+        return False
+    except OSError:
+        os._exit(0)
+    try:
+        req = recv_frame(conn)
+        pid = os.fork()
+        if pid == 0:
+            _become_worker(req, conn)  # never returns
+        children[pid] = req["log_base"]
+        send_frame(conn, ("ok", pid))
+    except Exception:  # noqa: BLE001 - a bad request must not kill the zygote
+        import traceback
+
+        traceback.print_exc()
+    finally:
+        try:
+            conn.close()
+        except OSError:
+            pass
+    return True
+
+
 def main() -> None:
     global _listener
     run_dir = sys.argv[1]
     _warm_imports()
-
-    from raydp_tpu.cluster.common import recv_frame, send_frame
 
     path = zygote_sock_path(run_dir)
     try:
@@ -125,9 +163,13 @@ def main() -> None:
     _listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     _listener.bind(path)
     _listener.listen(64)
-    _listener.settimeout(0.2)
     parent = os.getppid()
     children: dict = {}  # pid -> log_base, for exit markers at reap time
+
+    # 50ms accept timeout bounds child-reap latency (the .exit markers are
+    # one of the signals ZygoteProc.poll reads; zombie detection via /proc
+    # covers the window before the marker lands)
+    _listener.settimeout(0.05)
     while True:
         # reap exited children; record each child's true exit status in an
         # ``<log_base>.exit`` marker. Monitors hold only a pid (the child is
@@ -152,28 +194,7 @@ def main() -> None:
                     pass
         if os.getppid() != parent:
             os._exit(0)  # the head/agent died; the cluster is gone
-        try:
-            conn, _ = _listener.accept()
-        except socket.timeout:
-            continue
-        except OSError:
-            os._exit(0)
-        try:
-            req = recv_frame(conn)
-            pid = os.fork()
-            if pid == 0:
-                _become_worker(req, conn)  # never returns
-            children[pid] = req["log_base"]
-            send_frame(conn, ("ok", pid))
-        except Exception:  # noqa: BLE001 - a bad request must not kill the zygote
-            import traceback
-
-            traceback.print_exc()
-        finally:
-            try:
-                conn.close()
-            except OSError:
-                pass
+        _serve_one(children)
 
 
 if __name__ == "__main__":

@@ -31,10 +31,15 @@ class EtlExecutor:
         return self.executor_id
 
     def run_task(self, spec: T.TaskSpec) -> T.TaskResult:
-        return T.run_task(spec)
+        import time
+
+        t0 = time.perf_counter()
+        result = T.run_task(spec)
+        result.server_seconds = time.perf_counter() - t0
+        return result
 
     def run_tasks(self, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
-        return [T.run_task(s) for s in specs]
+        return [self.run_task(s) for s in specs]
 
     # -- data plane (exchange layer reads, SURVEY.md §3.6 analog) --
 

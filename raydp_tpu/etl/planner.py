@@ -201,22 +201,30 @@ class Planner:
                 (self._dispatch(spec, i, 0, prefs[i]), spec, i)
                 for i, spec in enumerate(specs)
             ]
-            return self._gather(futures, specs)
+            results = self._gather(futures, specs)
+            return results
         finally:
             if hook is not None:
                 with self._inflight_lock:
                     self._inflight -= 1
             log = getattr(self._tls, "stage_log", None)
             if log is not None:
-                log.append(
-                    {
-                        "tasks": len(specs),
-                        "seconds": time.perf_counter() - stage_start,
-                        "locality_preferred": sum(
-                            1 for p in prefs if p is not None
-                        ),
-                    }
-                )
+                entry = {
+                    "tasks": len(specs),
+                    "seconds": time.perf_counter() - stage_start,
+                    "locality_preferred": sum(
+                        1 for p in prefs if p is not None
+                    ),
+                }
+                try:
+                    # executor-side wall time per task: lets query stats
+                    # split compute from dispatch/transport overhead
+                    entry["server_seconds"] = round(
+                        sum(r.server_seconds for r in results), 6
+                    )
+                except (NameError, AttributeError):
+                    pass  # driver-local fallback path has no server timing
+                log.append(entry)
 
     def _gather(self, futures, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
         results: List[Optional[T.TaskResult]] = [None] * len(specs)

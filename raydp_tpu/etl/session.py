@@ -466,7 +466,7 @@ class EtlSession:
                         break
                 except Exception:
                     break
-                time.sleep(0.05)
+                time.sleep(0.01)  # the head reaps intentional kills in ~ms
         if cleanup_data and del_obj_holder:
             try:
                 self.master.kill(no_restart=True)

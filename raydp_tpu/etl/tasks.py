@@ -149,6 +149,9 @@ class TaskResult:
     num_rows: List[int] = field(default_factory=list)
     inline_ipc: Optional[bytes] = None
     count: int = 0
+    # server-side wall time of the task body (read→compute→emit), for query
+    # stats: lets the driver tell executor compute from dispatch/transport
+    server_seconds: float = 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -610,15 +613,57 @@ def _merge_fns(aggs: Sequence[AggExpr]) -> List[str]:
 # ---------------------------------------------------------------------------
 
 
+def _hash_numeric(values: np.ndarray) -> np.ndarray:
+    """pandas.util.hash_array's numeric path, bit-exact, without pandas: a
+    splitmix64-style mixer over the raw 8-byte view. Numeric hashing is the
+    shuffle/F.hash hot path, and importing pandas for it cost each executor
+    ~0.3s on its first task (the zygote warms pandas AFTER serving
+    first-session forks)."""
+    if values.dtype.kind == "b":
+        u = values.astype("u8")
+    elif values.dtype.itemsize == 8:
+        u = values.view("u8").copy()
+    else:
+        u = values.view(f"u{values.dtype.itemsize}").astype("u8")
+    u ^= u >> np.uint64(30)
+    u *= np.uint64(0xBF58476D1CE4E5B9)
+    u ^= u >> np.uint64(27)
+    u *= np.uint64(0x94D049BB133111EB)
+    u ^= u >> np.uint64(31)
+    return u
+
+
 def stable_hash_column(column) -> np.ndarray:
     """Cross-process-deterministic per-row uint64 hash (the shuffle contract:
     the same key must land on the same reducer no matter which executor hashed
-    it). pandas hash_array is siphash with a fixed key — stable everywhere."""
-    import pandas as pd
-
+    it). Matches pandas hash_array everywhere: numerics via the pandas-free
+    mixer above, strings/objects via pandas' keyed siphash."""
     if isinstance(column, pa.ChunkedArray):
         column = column.combine_chunks()
-    values = column.to_pandas()
+    if isinstance(column, pa.Array) and (
+        pa.types.is_integer(column.type)
+        or pa.types.is_floating(column.type)
+        or pa.types.is_boolean(column.type)
+    ):
+        if not column.null_count:
+            return _hash_numeric(column.to_numpy(zero_copy_only=False))
+        # nulls: hash the values in their ORIGINAL dtype and stamp null
+        # positions with a fixed constant. Routing null-bearing partitions
+        # through pandas instead would hash a nullable int column as
+        # float64 (to_pandas converts) while null-free partitions hash int
+        # bits — the same key would land on different reducers.
+        mask = column.is_null().to_numpy(zero_copy_only=False)
+        filled = column.fill_null(
+            False if pa.types.is_boolean(column.type) else 0
+        ).to_numpy(zero_copy_only=False)
+        hashed = _hash_numeric(filled)
+        hashed[mask] = np.uint64(0x9E3779B97F4A7C15)
+        return hashed
+    if isinstance(column, np.ndarray) and column.dtype.kind in "biuf":
+        return _hash_numeric(column)
+    import pandas as pd
+
+    values = column.to_pandas() if not isinstance(column, np.ndarray) else column
     return pd.util.hash_array(np.asarray(values)).astype(np.uint64)
 
 
@@ -660,7 +705,10 @@ def _split_table(table: pa.Table, indices: np.ndarray, num_splits: int) -> List[
 # ---------------------------------------------------------------------------
 
 
-def run_task(spec: TaskSpec) -> TaskResult:
+def _read_and_merge(spec: TaskSpec) -> pa.Table:
+    """Read inputs and apply the stage's merge step (join/final_agg/sort/
+    distinct) — shared by the plain and traced task runners so the trace
+    path can never diverge from real execution."""
     tables = [_read_one(r) for r in spec.reads]
     if spec.merge.kind == "join":
         left = (
@@ -669,34 +717,73 @@ def run_task(spec: TaskSpec) -> TaskResult:
             else tables[0]
         )
         right = _read_one(spec.merge.right)
-        table = left.join(
+        return left.join(
             right, keys=spec.merge.keys, join_type=spec.merge.join_how,
             use_threads=False,
         )
-    else:
-        table = (
-            pa.concat_tables(tables, promote_options="permissive")
-            if len(tables) > 1
-            else tables[0]
+    table = (
+        pa.concat_tables(tables, promote_options="permissive")
+        if len(tables) > 1
+        else tables[0]
+    )
+    if spec.merge.kind == "final_agg":
+        table = final_agg(table, spec.merge.keys, spec.merge.aggs)
+    elif spec.merge.kind == "sort":
+        table = table.sort_by(
+            [
+                (k, "ascending" if asc else "descending")
+                for k, asc in zip(spec.merge.keys, spec.merge.ascending)
+            ]
         )
-        if spec.merge.kind == "final_agg":
-            table = final_agg(table, spec.merge.keys, spec.merge.aggs)
-        elif spec.merge.kind == "sort":
-            table = table.sort_by(
-                [
-                    (k, "ascending" if asc else "descending")
-                    for k, asc in zip(spec.merge.keys, spec.merge.ascending)
-                ]
-            )
-        elif spec.merge.kind == "distinct":
-            table = table.group_by(
-                table.column_names, use_threads=False
-            ).aggregate([])
+    elif spec.merge.kind == "distinct":
+        table = table.group_by(
+            table.column_names, use_threads=False
+        ).aggregate([])
+    return table
 
+
+def run_task(spec: TaskSpec) -> TaskResult:
+    if os.environ.get("RAYDP_TPU_TASK_TRACE"):
+        return _run_task_traced(spec)
+    table = _read_and_merge(spec)
     for node in spec.chain:
         table = apply_narrow(table, node, spec.partition_index)
-
     return _emit(table, spec)
+
+
+_TRACE_SEQ = iter(range(1 << 62))  # per-process trace-file sequence
+
+
+def _run_task_traced(spec: TaskSpec) -> TaskResult:
+    """Debug-only (RAYDP_TPU_TASK_TRACE=<path-prefix>): per-phase wall times
+    and newly-imported modules, one JSON file per task. Execution is the
+    SAME code as run_task (shared _read_and_merge/apply_narrow/_emit)."""
+    import json
+    import sys
+    import time
+
+    t = {}
+    before = set(sys.modules)
+    t0 = time.perf_counter()
+    table = _read_and_merge(spec)
+    t["read_merge"] = round(time.perf_counter() - t0, 3)
+    for i, node in enumerate(spec.chain):
+        t0 = time.perf_counter()
+        table = apply_narrow(table, node, spec.partition_index)
+        t[f"chain{i}:{type(node).__name__}"] = round(time.perf_counter() - t0, 3)
+    t0 = time.perf_counter()
+    result = _emit(table, spec)
+    t["emit"] = round(time.perf_counter() - t0, 3)
+    t["new_mods"] = sorted(
+        m for m in (set(sys.modules) - before) if "." not in m
+    )[:20]
+    path = (
+        os.environ["RAYDP_TPU_TASK_TRACE"]
+        + f".{os.getpid()}.{next(_TRACE_SEQ)}"
+    )
+    with open(path, "w") as f:
+        json.dump(t, f)
+    return result
 
 
 def _emit(table: pa.Table, spec: TaskSpec) -> TaskResult:

@@ -745,6 +745,44 @@ def test_grouped_stddev_nan_key(session):
         )
 
 
+def test_stable_hash_matches_pandas():
+    """The pandas-free numeric mixer must stay BIT-EXACT with
+    pandas.util.hash_array: the shuffle contract (same key → same reducer)
+    spans processes that may take either path (numeric fast path vs the
+    pandas fallback for strings/nullable columns)."""
+    import pyarrow as pa
+
+    from raydp_tpu.etl.tasks import stable_hash_column
+
+    rng = np.random.default_rng(9)
+    cases = [
+        rng.integers(-(2**62), 2**62, 100, dtype=np.int64),
+        rng.integers(0, 1000, 100).astype(np.int32),
+        rng.standard_normal(100),
+        rng.standard_normal(100).astype(np.float32),
+        np.array([True, False] * 50),
+    ]
+    for arr in cases:
+        expected = pd.util.hash_array(arr).astype(np.uint64)
+        np.testing.assert_array_equal(stable_hash_column(pa.array(arr)), expected)
+        np.testing.assert_array_equal(stable_hash_column(arr), expected)
+    # string (object) path still matches via the pandas fallback
+    s = np.array(["a", "bb", "ccc"] * 10, dtype=object)
+    np.testing.assert_array_equal(
+        stable_hash_column(pa.array(s)), pd.util.hash_array(s).astype(np.uint64)
+    )
+    # shuffle contract across partitions: an int key must hash IDENTICALLY
+    # whether or not its partition happens to contain a null (to_pandas
+    # would quietly convert a nullable int column to float64 and change
+    # every hash in that partition)
+    clean = pa.array(np.array([5, 7, 9], dtype=np.int64))
+    withnull = pa.array([5, None, 9], type=pa.int64())
+    h_clean = stable_hash_column(clean)
+    h_null = stable_hash_column(withnull)
+    assert h_null[0] == h_clean[0] and h_null[2] == h_clean[2]
+    assert h_null[1] not in (h_clean[0], h_clean[2])
+
+
 def test_variance_numerically_stable(session):
     """Large-mean/small-variance data: the naive Σx² − (Σx)²/n identity
     cancels catastrophically in f64 (returns 0); the Chan-style partial
