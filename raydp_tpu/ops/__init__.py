@@ -6,13 +6,18 @@ from raydp_tpu.ops.embedding import (
 )
 from raydp_tpu.ops.flash_attention import flash_attention
 from raydp_tpu.ops.interaction import dot_interaction, dot_interaction_pallas
-from raydp_tpu.ops.quantization import dequantize_int8, quantize_int8
+from raydp_tpu.ops.quantization import (
+    dequantize_int8,
+    int8_matmul,
+    quantize_int8,
+)
 
 __all__ = [
     "dequantize_int8",
     "dot_interaction",
     "dot_interaction_pallas",
     "flash_attention",
+    "int8_matmul",
     "quantize_int8",
     "embedding_lookup_vocab_sharded",
     "sharded_embedding_lookup",

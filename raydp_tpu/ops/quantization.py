@@ -44,6 +44,68 @@ def dequantize_int8(values: jnp.ndarray, scales: jnp.ndarray) -> jnp.ndarray:
     return values.astype(jnp.float32) * scales
 
 
+@jax.custom_vjp
+def int8_matmul(x: jnp.ndarray, w: jnp.ndarray) -> jnp.ndarray:
+    """``x [..., K] @ w [K, M]`` computed on the MXU's int8 path (2x the
+    bf16 rate on v5e/v5p): activations quantize per-row, weights per-column,
+    the dot runs int8xint8->int32, and the output dequantizes by the outer
+    product of scales. Training-safe via the straight-through estimator —
+    the backward pass differentiates the EXACT matmul at the float inputs
+    (standard int8-forward training recipe), so gradients are the bf16
+    matmul gradients, not zero (quantize's round has no gradient).
+
+    Quantization error is bounded by the per-row/column max-abs scaling
+    (~0.4% relative per operand); intended for the MLP blocks where the
+    4d contraction amortizes the quantize/dequantize VPU work."""
+    xq, xs = quantize_int8(x.reshape(-1, x.shape[-1]).astype(jnp.float32))
+    wq, ws = quantize_int8(w.T.astype(jnp.float32))  # per-COLUMN scales of w
+    y = jax.lax.dot_general(
+        xq, wq.T,
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    out = y.astype(jnp.float32) * xs * ws.T
+    return out.reshape(x.shape[:-1] + (w.shape[-1],))
+
+
+def _int8_matmul_fwd(x, w):
+    return int8_matmul(x, w), (x, w)
+
+
+def _int8_matmul_bwd(res, g):
+    x, w = res
+    # straight-through: grads of the exact float matmul, in the inputs'
+    # dtypes (bf16 keeps the backward on the MXU's bf16 path)
+    gx = jnp.einsum("...m,km->...k", g.astype(x.dtype), w.astype(x.dtype))
+    gw = jnp.einsum(
+        "...k,...m->km",
+        x.astype(jnp.float32),
+        g.astype(jnp.float32),
+    ).astype(w.dtype)
+    return gx.astype(x.dtype), gw
+
+
+int8_matmul.defvjp(_int8_matmul_fwd, _int8_matmul_bwd)
+
+
+def int8_dot_general(
+    lhs, rhs, dimension_numbers, precision=None, preferred_element_type=None
+):
+    """Drop-in ``dot_general`` for ``flax.linen.Dense(dot_general=...)``:
+    routes the Dense contraction ([..., K] x [K, M]) through int8_matmul
+    (output cast back to the promoted input dtype so downstream activations
+    keep the module's dtype); any other contraction falls through to lax.
+    Using it keeps the param tree IDENTICAL to a plain Dense, so bf16 and
+    int8-forward checkpoints interchange freely."""
+    ((lc, rc), (lb, rb)) = dimension_numbers
+    if tuple(lc) == (lhs.ndim - 1,) and tuple(rc) == (0,) and not lb and not rb:
+        return int8_matmul(lhs, rhs).astype(lhs.dtype)
+    return jax.lax.dot_general(
+        lhs, rhs, dimension_numbers,
+        precision=precision, preferred_element_type=preferred_element_type,
+    )
+
+
 def _quant_kernel(x_ref, seed_ref, values_ref, scales_ref):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
