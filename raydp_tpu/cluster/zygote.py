@@ -95,6 +95,13 @@ def _become_worker(req: dict, conn: socket.socket) -> None:
         env = req["env"]
         os.environ.clear()
         os.environ.update(env)
+        # adopt the SPAWNER's cwd (what a cold subprocess start would
+        # inherit): a machine-global zygote's own cwd is whichever driver
+        # started it first — possibly deleted, and never session B's
+        try:
+            os.chdir(req.get("cwd") or req["run_dir"])
+        except OSError:
+            os.chdir("/")
         # PYTHONPATH is normally consumed at interpreter start — this child
         # skipped that, so graft any missing entries onto sys.path (user
         # actor classes may live outside the zygote's own path)
@@ -122,7 +129,9 @@ def _become_worker(req: dict, conn: socket.socket) -> None:
 
 
 def _serve_one(children: dict) -> bool:
-    """Accept and serve one fork request; False on accept timeout."""
+    """Accept and serve one fork request; False on accept timeout. An
+    empty connection (the adoption path's idle-clock poke, liveness
+    probes) counts as activity but forks nothing."""
     from raydp_tpu.cluster.common import recv_frame, send_frame
 
     try:
@@ -132,7 +141,10 @@ def _serve_one(children: dict) -> bool:
     except OSError:
         os._exit(0)
     try:
-        req = recv_frame(conn)
+        try:
+            req = recv_frame(conn)
+        except (ConnectionError, EOFError):
+            return True  # poke/probe: no request followed the connect
         pid = os.fork()
         if pid == 0:
             _become_worker(req, conn)  # never returns
@@ -150,9 +162,21 @@ def _serve_one(children: dict) -> bool:
     return True
 
 
+GLOBAL_MODE_ENV = "RAYDP_TPU_ZYGOTE_GLOBAL"
+# a machine-global zygote with no fork requests for this long exits (it has
+# no owning cluster to die with; sessions re-adopt or restart one on demand)
+GLOBAL_IDLE_TTL_S = 1800.0
+
+
 def main() -> None:
     global _listener
     run_dir = sys.argv[1]
+    # global mode (common.start_zygote): this zygote serves EVERY cluster of
+    # this user+source-tree on the machine — fork requests carry the target
+    # session's run_dir/env, so nothing here is session-specific. It ignores
+    # parent death (its starter is just whichever driver came first) and
+    # retires itself after an idle TTL instead.
+    global_mode = os.environ.get(GLOBAL_MODE_ENV) == "1"
     _warm_imports()
 
     path = zygote_sock_path(run_dir)
@@ -165,6 +189,9 @@ def main() -> None:
     _listener.listen(64)
     parent = os.getppid()
     children: dict = {}  # pid -> log_base, for exit markers at reap time
+    import time as _time
+
+    last_fork = _time.monotonic()
 
     # 50ms accept timeout bounds child-reap latency (the .exit markers are
     # one of the signals ZygoteProc.poll reads; zombie detection via /proc
@@ -192,9 +219,38 @@ def main() -> None:
                     os.replace(log_base + ".exit.tmp", log_base + ".exit")
                 except OSError:
                     pass
-        if os.getppid() != parent:
+        if global_mode:
+            # linger only while useful: exit when idle past the TTL and no
+            # children remain to reap (their exit markers must not be lost).
+            # The adoption lock serializes retirement against adoption — a
+            # session that just adopted this template must not watch it
+            # vanish between its liveness check and its first fork.
+            if (
+                not children
+                and _time.monotonic() - last_fork > GLOBAL_IDLE_TTL_S
+            ):
+                import fcntl
+
+                try:
+                    lock_file = open(os.path.join(run_dir, ".lock"), "w")
+                except OSError:
+                    continue
+                try:
+                    fcntl.flock(lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
+                except OSError:
+                    lock_file.close()
+                    continue  # adoption in progress: stay alive this round
+                marker = zygote_marker_path(run_dir)
+                for stale in (path, marker, marker + ".start"):
+                    try:  # a marker left behind + pid reuse would make a
+                        os.unlink(stale)  # later adoption latch onto an
+                    except OSError:  # unrelated process
+                        pass
+                os._exit(0)  # lock released by process exit
+        elif os.getppid() != parent:
             os._exit(0)  # the head/agent died; the cluster is gone
-        _serve_one(children)
+        if _serve_one(children):
+            last_fork = _time.monotonic()
 
 
 if __name__ == "__main__":

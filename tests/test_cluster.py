@@ -247,6 +247,49 @@ def test_multinode_spread_and_node_kill(runtime):
         cluster.remove_node(n1)
 
 
+def test_global_zygote_key_and_guards(tmp_path):
+    """The machine-global zygote's safety rails: the source key changes when
+    any module's mtime changes (stale templates can never serve new code),
+    and marker liveness is identity-checked by (pid, starttime) so a REUSED
+    pid — even one whose fork-inherited cmdline still looks like a zygote —
+    reads as dead instead of latching adoption onto an impostor."""
+    from raydp_tpu.cluster.common import (
+        _marker_pid_alive,
+        _pid_alive_not_zombie,
+        _proc_starttime,
+        _write_zygote_marker,
+        _zygote_source_key,
+    )
+
+    key1 = _zygote_source_key()
+    assert key1 == _zygote_source_key()  # stable while nothing changes
+
+    import raydp_tpu
+
+    probe_file = os.path.join(
+        os.path.dirname(os.path.abspath(raydp_tpu.__file__)), "utils.py"
+    )
+    st = os.stat(probe_file)
+    try:
+        os.utime(probe_file, ns=(st.st_atime_ns, st.st_mtime_ns + 1))
+        assert _zygote_source_key() != key1
+    finally:
+        os.utime(probe_file, ns=(st.st_atime_ns, st.st_mtime_ns))
+    assert _zygote_source_key() == key1
+
+    assert _pid_alive_not_zombie(os.getpid())
+    marker = str(tmp_path / "zygote.pid")
+    _write_zygote_marker(marker, os.getpid())
+    assert _marker_pid_alive(marker) == os.getpid()  # same incarnation
+    # simulate pid reuse: same pid, different recorded starttime
+    with open(marker + ".start", "w") as f:
+        f.write(str(_proc_starttime(os.getpid()) - 1))
+    assert _marker_pid_alive(marker) is None
+    # dead pid
+    _write_zygote_marker(marker, 2**22 + 12345)  # almost surely unused
+    assert _marker_pid_alive(marker) is None
+
+
 @pytest.mark.skipif(
     bool(os.environ.get("RAYDP_TPU_TEST_ATTACH_TCP")),
     reason="introspects the head host's session dir (zygote marker files); "
