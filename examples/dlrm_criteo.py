@@ -42,15 +42,18 @@ def main():
     rows = int(os.environ.get("EXAMPLE_ROWS", 50_000))
     df = session.from_pandas(synthetic_criteo(rows), num_partitions=8)
 
-    # preprocessing (notebook parity): log1p the dense ints, hash categories
+    # preprocessing (notebook parity): log1p the dense ints, hash categories.
+    # Ids stay INTEGER end to end: the estimator's categorical_columns stage
+    # them as a separate int32 matrix, exact at ANY vocab size (a float32
+    # matrix would silently collapse ids beyond 2^24 — real Criteo vocabs
+    # are tens of millions)
     for i in range(NUM_DENSE):
         df = df.with_column(f"i{i}", F.log1p(F.col(f"i{i}")).cast("float32"))
     for j, vocab in enumerate(CAT_VOCABS):
-        df = df.with_column(f"c{j}", F.hash(f"c{j}", vocab).cast("float32"))
+        df = df.with_column(f"c{j}", F.hash(f"c{j}", vocab).cast("int32"))
 
-    features = [f"i{i}" for i in range(NUM_DENSE)] + [
-        f"c{j}" for j in range(len(CAT_VOCABS))
-    ]
+    dense_cols = [f"i{i}" for i in range(NUM_DENSE)]
+    cat_cols = [f"c{j}" for j in range(len(CAT_VOCABS))]
     train_df, test_df = df.random_split([0.9, 0.1], seed=0)
 
     n_dev = len(jax.devices())
@@ -65,7 +68,8 @@ def main():
         optimizer="adam",
         loss="bce",
         metrics=["accuracy"],
-        feature_columns=features,
+        feature_columns=dense_cols + cat_cols,
+        categorical_columns=cat_cols,  # (dense f32, ids i32) mixed staging
         label_column="label",
         batch_size=512,
         num_epochs=int(os.environ.get("EXAMPLE_EPOCHS", 3)),
