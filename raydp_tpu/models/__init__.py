@@ -1,6 +1,6 @@
 """Model zoo: the reference's workload families, TPU-native."""
 
-from raydp_tpu.models.dlrm import DLRM, dlrm_sharding_rules
+from raydp_tpu.models.dlrm import DLRM, dlrm_optimizer, dlrm_sharding_rules
 from raydp_tpu.models.mlp import MLPClassifier, MLPRegressor
 from raydp_tpu.models.transformer import TransformerLM, sequence_parallel_apply
 
@@ -9,6 +9,7 @@ __all__ = [
     "MLPClassifier",
     "MLPRegressor",
     "TransformerLM",
+    "dlrm_optimizer",
     "dlrm_sharding_rules",
     "sequence_parallel_apply",
 ]

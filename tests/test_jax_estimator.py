@@ -352,18 +352,22 @@ def test_dlrm_big_vocab_exact_ids(session):
             "label": (ids % 2).astype(np.float32),
         }
     )
+    from raydp_tpu.models import dlrm_optimizer
+
     df = session.from_pandas(pdf, num_partitions=2)
     ds = dataframe_to_dataset(df)
     est = JaxEstimator(
         model=DLRM(vocab_sizes=[vocab], num_dense=1, embed_dim=2),
-        optimizer="sgd",
+        # the Criteo-scale recipe: Adafactor on the tables (dense Adam's
+        # two full-table moment copies OOM a real chip at big vocabs),
+        # Adam on the MLPs
+        optimizer=dlrm_optimizer(embedding_lr=0.5, dense_lr=1e-2),
         loss="bce",
         feature_columns=["d0", "c0"],
         categorical_columns=["c0"],
         label_column="label",
         batch_size=64,
         num_epochs=2,
-        learning_rate=0.5,
         seed=0,
     )
     history = est.fit(ds)
