@@ -16,7 +16,7 @@ import pandas as pd
 import raydp_tpu
 from raydp_tpu.estimator import JaxEstimator
 from raydp_tpu.etl import functions as F
-from raydp_tpu.models import DLRM, dlrm_sharding_rules
+from raydp_tpu.models import DLRM, dlrm_optimizer, dlrm_sharding_rules
 from raydp_tpu.parallel import make_mesh
 
 NUM_DENSE = 4
@@ -65,7 +65,9 @@ def main():
             vocab_sizes=CAT_VOCABS, num_dense=NUM_DENSE, embed_dim=16,
             bottom_mlp=(64, 32), top_mlp=(64, 32),
         ),
-        optimizer="adam",
+        # Adafactor on the tables, Adam on the MLPs: dense Adam's two
+        # full-table moment copies OOM a chip at real Criteo vocabs
+        optimizer=dlrm_optimizer(),
         loss="bce",
         metrics=["accuracy"],
         feature_columns=dense_cols + cat_cols,

@@ -122,6 +122,11 @@ def coalesce(*cols: ColumnLike) -> Expr:
     return Function("coalesce", [_c(c) for c in cols])
 
 
+# Spark's SQL-flavored aliases for two-arg coalesce
+nvl = coalesce
+ifnull = coalesce
+
+
 def abs(c: ColumnLike) -> Expr:  # noqa: A001
     return Function("abs", [_c(c)])
 
@@ -183,6 +188,122 @@ def concat(*cols: ColumnLike) -> Expr:
     )
 
 
+def _string_udf(per_value: Callable, cols, dtype="string") -> Expr:
+    """Vectorized per-row string UDF with SPARK null semantics: null in →
+    null out (the raw arrow array iterates as pa.Scalar objects, which are
+    never ``None`` — ``to_pylist`` restores real Nones)."""
+
+    def _fn(values):
+        pylist = values.to_pylist() if hasattr(values, "to_pylist") else list(values)
+        return np.array(
+            [None if v is None else per_value(v) for v in pylist], dtype=object
+        )
+
+    return Udf(_fn, cols, dtype=dtype)
+
+
+def concat_ws(sep: str, *cols: ColumnLike) -> Expr:
+    """Concatenate with a separator, SKIPPING nulls (Spark concat_ws drops
+    null arguments and returns "" when every argument is null — it never
+    returns null). Row-wise UDF: arrow's join kernel with
+    ``null_handling="skip"`` mis-sizes its output when a row is all-null
+    (observed: a 1-row all-null input yields a 0-row result)."""
+
+    def _fn(*arrays):
+        lists = [
+            a.to_pylist() if hasattr(a, "to_pylist") else list(a)
+            for a in arrays
+        ]
+        return np.array(
+            [
+                str(sep).join(str(v) for v in row if v is not None)
+                for row in zip(*lists)
+            ],
+            dtype=object,
+        )
+
+    return Udf(_fn, [_c(c) for c in cols], dtype="string")
+
+
+def initcap(c: ColumnLike) -> Expr:
+    """Capitalize the first letter of each word, lowercase the rest."""
+    return Function("utf8_title", [_c(c)])
+
+
+def reverse(c: ColumnLike) -> Expr:
+    return Function("utf8_reverse", [_c(c)])
+
+
+def repeat(c: ColumnLike, n: int) -> Expr:
+    return Function("binary_repeat", [_c(c), Literal(int(n))])
+
+
+def instr(c: ColumnLike, substr: str) -> Expr:
+    """1-based index of the first occurrence; 0 when absent (Spark semantics;
+    arrow's find_substring is 0-based with -1 absent)."""
+    found = Function("find_substring", [_c(c)], options={"pattern": substr})
+    return Function("add", [found, Literal(1)])
+
+
+def locate(substr: str, c: ColumnLike, pos: int = 1) -> Expr:
+    if pos != 1:
+        raise NotImplementedError("locate with pos != 1 is not supported")
+    return instr(c, substr)
+
+
+def translate(c: ColumnLike, matching: str, replace_: str) -> Expr:
+    """Per-character translation (Spark translate): chars in ``matching``
+    map positionally to ``replace_``; extra matching chars are deleted; a
+    duplicated matching char keeps its FIRST mapping (Spark semantics)."""
+    table: dict = {}
+    for i, m in enumerate(matching):
+        table.setdefault(
+            ord(m), replace_[i] if i < len(replace_) else None
+        )
+    return _string_udf(lambda v: str(v).translate(table), [_c(c)])
+
+
+def like(c: ColumnLike, pattern: str) -> Expr:
+    """SQL LIKE (% and _ wildcards)."""
+    return Function("match_like", [_c(c)], options={"pattern": pattern})
+
+
+def md5(c: ColumnLike) -> Expr:
+    """Hex md5 digest of the string column (Spark md5)."""
+    import hashlib
+
+    return _string_udf(
+        lambda v: hashlib.md5(str(v).encode()).hexdigest(), [_c(c)]
+    )
+
+
+def sha2(c: ColumnLike, num_bits: int = 256) -> Expr:
+    """Hex SHA-2 digest (Spark sha2; num_bits in 224/256/384/512)."""
+    import hashlib
+
+    algo = {224: "sha224", 256: "sha256", 384: "sha384", 512: "sha512"}.get(
+        int(num_bits)
+    )
+    if algo is None:
+        raise ValueError(f"sha2 num_bits must be 224/256/384/512, got {num_bits}")
+    h = getattr(hashlib, algo)
+    return _string_udf(lambda v: h(str(v).encode()).hexdigest(), [_c(c)])
+
+
+def base64(c: ColumnLike) -> Expr:
+    import base64 as b64
+
+    return _string_udf(
+        lambda v: b64.b64encode(str(v).encode()).decode(), [_c(c)]
+    )
+
+
+def unbase64(c: ColumnLike) -> Expr:
+    import base64 as b64
+
+    return _string_udf(lambda v: b64.b64decode(str(v)), [_c(c)], dtype="binary")
+
+
 # -- datetime (NYCTaxi feature engineering uses these heavily) ---------------
 
 
@@ -225,11 +346,143 @@ def to_timestamp(c: ColumnLike, fmt: Optional[str] = None) -> Expr:
     return Function("strptime", [_c(c)], options={"format": fmt, "unit": "us"})
 
 
+_JAVA_TO_STRFTIME = [
+    ("yyyy", "%Y"), ("yy", "%y"), ("MM", "%m"), ("dd", "%d"),
+    ("HH", "%H"), ("hh", "%I"), ("mm", "%M"), ("ss", "%S"),
+    ("a", "%p"), ("EEEE", "%A"), ("EEE", "%a"),
+]
+
+
+def _java_datetime_format(fmt: str) -> str:
+    """Translate the common Java/Spark datetime pattern tokens to strftime
+    (yyyy-MM-dd HH:mm:ss → %Y-%m-%d %H:%M:%S) — ported Spark code keeps its
+    format strings unchanged. Java single-quoted literals ('T') pass
+    through untranslated with the quotes stripped ('' = a literal quote).
+    Sub-second SSS is rejected: arrow's strftime is C strftime (no %f)."""
+    import re as _re
+
+    if "SSS" in fmt:
+        raise NotImplementedError(
+            "sub-second (SSS) patterns are not supported by arrow's strftime"
+        )
+    parts = _re.split(r"'([^']*)'", fmt)
+    out = []
+    for i, part in enumerate(parts):
+        if i % 2 == 1:  # quoted literal; '' means one literal quote
+            out.append(part if part else "'")
+        else:
+            for java, strf in _JAVA_TO_STRFTIME:
+                part = part.replace(java, strf)
+            out.append(part)
+    return "".join(out)
+
+
+def _strftime_expr(child: Expr, fmt: str) -> Expr:
+    """strftime at second resolution (arrow's %S appends fractional digits
+    at sub-second timestamp units; SSS is rejected upstream)."""
+    import pyarrow as pa
+
+    from raydp_tpu.etl.expressions import Cast
+
+    strf = _java_datetime_format(fmt)
+    return Function(
+        "strftime", [Cast(child, pa.timestamp("s"))], options={"format": strf}
+    )
+
+
+def date_format(c: ColumnLike, fmt: str) -> Expr:
+    """Format a timestamp as a string with a Java-style pattern (Spark
+    date_format)."""
+    return _strftime_expr(_c(c), fmt)
+
+
+def from_unixtime(c: ColumnLike, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Expr:
+    """Seconds-since-epoch → formatted string (Spark from_unixtime)."""
+    import pyarrow as pa
+
+    from raydp_tpu.etl.expressions import Cast
+
+    as_ts = Cast(
+        Function("multiply", [_c(c).cast("int64"), Literal(1_000_000)]),
+        pa.timestamp("us"),
+    )
+    return _strftime_expr(as_ts, fmt)
+
+
+def date_add(c: ColumnLike, days: int) -> Expr:
+    """Shift a date/timestamp by whole days (Spark date_add)."""
+
+    def _fn(values):
+        arr = np.asarray(values)
+        if np.issubdtype(arr.dtype, np.datetime64):
+            return arr + np.timedelta64(int(days), "D")
+        raise TypeError(f"date_add expects a date/timestamp column, got {arr.dtype}")
+
+    return Udf(_fn, [_c(c)])
+
+
+def date_sub(c: ColumnLike, days: int) -> Expr:
+    return date_add(c, -int(days))
+
+
 # -- misc --------------------------------------------------------------------
 
 
 def sin(c: ColumnLike) -> Expr:
     return Function("sin", [_c(c)])
+
+
+def asin(c: ColumnLike) -> Expr:
+    return Function("asin", [_c(c)])
+
+
+def acos(c: ColumnLike) -> Expr:
+    return Function("acos", [_c(c)])
+
+
+def sinh(c: ColumnLike) -> Expr:
+    return Function("sinh", [_c(c)])
+
+
+def cosh(c: ColumnLike) -> Expr:
+    return Function("cosh", [_c(c)])
+
+
+def tanh(c: ColumnLike) -> Expr:
+    return Function("tanh", [_c(c)])
+
+
+def degrees(c: ColumnLike) -> Expr:
+    return Function("multiply", [_c(c), Literal(180.0 / np.pi)])
+
+
+def radians(c: ColumnLike) -> Expr:
+    return Function("multiply", [_c(c), Literal(np.pi / 180.0)])
+
+
+def log2(c: ColumnLike) -> Expr:
+    return Function("log2", [_c(c)])
+
+
+def log10(c: ColumnLike) -> Expr:
+    return Function("log10", [_c(c)])
+
+
+def expm1(c: ColumnLike) -> Expr:
+    return Function("expm1", [_c(c)])
+
+
+def cbrt(c: ColumnLike) -> Expr:
+    """Cube root, defined for negatives like Spark/numpy (power(x, 1/3)
+    would be NaN for x < 0)."""
+
+    def _fn(values):
+        arr = values.to_numpy(zero_copy_only=False) if hasattr(
+            values, "to_numpy"
+        ) else np.asarray(values)
+        return np.cbrt(arr.astype(np.float64))
+
+    return Udf(_fn, [_c(c)], dtype="float64")
 
 
 def cos(c: ColumnLike) -> Expr:

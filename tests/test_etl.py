@@ -698,6 +698,114 @@ def test_function_spark_edge_semantics(session):
     assert out["rr"].tolist() == ["a!bcdef", "a!"]
 
 
+def test_scalar_function_batch_round5(session):
+    """Round-5 Spark-parity additions: string/hash/date/trig functions map
+    to arrow kernels (or vectorized UDFs) and match pyspark semantics."""
+    import base64 as b64
+    import hashlib
+
+    pdf = pd.DataFrame(
+        {
+            "s": ["hello world", "aBc", ""],
+            "x": [0.5, 1.0, 2.0],
+            "ts": pd.to_datetime(
+                ["2020-03-15 10:11:12", "2021-12-31 23:59:58", "2019-02-28 06:30:45"]
+            ),
+            "epoch": np.array([0, 1_600_000_000, 86400], dtype=np.int64),
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=2)
+    out = (
+        df.with_column("cw", F.concat_ws("-", "s", "s"))
+        .with_column("ic", F.initcap("s"))
+        .with_column("rv", F.reverse("s"))
+        .with_column("rp", F.repeat("s", 2))
+        .with_column("ins", F.instr("s", "o"))
+        .with_column("tr", F.translate("s", "lo", "L"))
+        .with_column("lk", F.like("s", "%world"))
+        .with_column("m5", F.md5("s"))
+        .with_column("s2", F.sha2("s", 256))
+        .with_column("b6", F.base64("s"))
+        .with_column("dfmt", F.date_format("ts", "yyyy-MM-dd HH:mm"))
+        .with_column("fut", F.from_unixtime("epoch"))
+        .with_column("da", F.date_add("ts", 10))
+        .with_column("ds", F.date_sub("ts", 1))
+        .with_column("sh", F.sinh("x"))
+        .with_column("deg", F.degrees("x"))
+        .with_column("l10", F.log10("x"))
+        .with_column("cb", F.cbrt("x"))
+        .with_column("nv", F.nvl("s", F.lit("?")))
+        .to_pandas()
+    )
+    assert out["cw"].tolist()[0] == "hello world-hello world"
+    assert out["ic"].tolist() == ["Hello World", "Abc", ""]
+    assert out["rv"].tolist() == ["dlrow olleh", "cBa", ""]
+    assert out["rp"].tolist()[1] == "aBcaBc"
+    assert out["ins"].tolist() == [5, 0, 0]  # 1-based; 0 when absent
+    assert out["tr"].tolist() == ["heLL wrLd", "aBc", ""]
+    assert out["lk"].tolist() == [True, False, False]
+    assert out["m5"].tolist() == [
+        hashlib.md5(s.encode()).hexdigest() for s in pdf["s"]
+    ]
+    assert out["s2"].tolist() == [
+        hashlib.sha256(s.encode()).hexdigest() for s in pdf["s"]
+    ]
+    assert out["b6"].tolist() == [
+        b64.b64encode(s.encode()).decode() for s in pdf["s"]
+    ]
+    assert out["dfmt"].tolist() == pdf["ts"].dt.strftime("%Y-%m-%d %H:%M").tolist()
+    assert out["fut"].tolist() == [
+        "1970-01-01 00:00:00", "2020-09-13 12:26:40", "1970-01-02 00:00:00"
+    ]
+    assert (
+        pd.to_datetime(out["da"]) - pdf["ts"] == pd.Timedelta(days=10)
+    ).all()
+    assert (
+        pdf["ts"] - pd.to_datetime(out["ds"]) == pd.Timedelta(days=1)
+    ).all()
+    np.testing.assert_allclose(out["sh"], np.sinh(pdf["x"]), rtol=1e-12)
+    np.testing.assert_allclose(out["deg"], np.degrees(pdf["x"]), rtol=1e-12)
+    np.testing.assert_allclose(out["l10"], np.log10(pdf["x"]), rtol=1e-12)
+    np.testing.assert_allclose(out["cb"], np.cbrt(pdf["x"]), rtol=1e-12)
+    assert out["nv"].tolist() == pdf["s"].tolist()  # non-null passthrough
+
+
+def test_function_batch_round5_edges(session):
+    """Spark-semantics edges of the round-5 functions: null in → null out
+    for the hash/string UDFs, concat_ws SKIPS nulls, cbrt of negatives,
+    translate keeps the FIRST mapping of a duplicated char, Java quoted
+    literals in date patterns, sub-second patterns rejected."""
+    pdf = pd.DataFrame(
+        {
+            "s": ["abc", None, None],
+            "t": ["x", "y", None],
+            "v": [-8.0, 27.0, 1.0],
+            "ts": pd.to_datetime(["2020-01-01 10:11:12"] * 3),
+        }
+    )
+    # 3 partitions: the last holds ONLY the all-null row (arrow's join
+    # kernel mis-sized its output exactly there before the UDF rewrite)
+    df = session.from_pandas(pdf, num_partitions=3)
+    out = (
+        df.with_column("m5", F.md5("s"))
+        .with_column("b6", F.base64("s"))
+        .with_column("cw", F.concat_ws("-", "s", "t"))
+        .with_column("cb", F.cbrt("v"))
+        .with_column("tr", F.translate("t", "xx", "ab"))
+        .with_column("iso", F.date_format("ts", "yyyy-MM-dd'T'HH:mm:ss"))
+        .to_pandas()
+    )
+    assert out["m5"][1] is None or pd.isna(out["m5"][1])  # null in, null out
+    assert out["b6"][1] is None or pd.isna(out["b6"][1])
+    # nulls SKIPPED; the all-null row gives "" (Spark: concat_ws never null)
+    assert out["cw"].tolist() == ["abc-x", "y", ""]
+    np.testing.assert_allclose(out["cb"], [-2.0, 3.0, 1.0], rtol=1e-12)
+    assert out["tr"].tolist()[:2] == ["a", "y"]  # first mapping of dup wins
+    assert out["iso"][0] == "2020-01-01T10:11:12"  # quotes stripped
+    with pytest.raises(NotImplementedError, match="SSS"):
+        df.with_column("bad", F.date_format("ts", "HH:mm:ss.SSS")).to_pandas()
+
+
 def test_regexp_replace_escaped_dollar(session):
     """Spark/Java: ``\\$`` in the replacement is a LITERAL dollar, not a
     capture reference; ``\\\\`` is a literal backslash. Escapes are consumed
