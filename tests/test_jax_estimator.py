@@ -284,6 +284,21 @@ def test_dlrm_mixed_dtype_streaming(session, criteo_df):
     assert history[-1]["train_loss"] < history[0]["train_loss"]
 
 
+def test_dlrm_mixed_dtype_streaming_hybrid(session, criteo_df):
+    """hybrid streaming × mixed-dtype: the device cache pins TUPLE-featured
+    segments (dense f32, ids i32) and later epochs scan them from HBM."""
+    ds = dataframe_to_dataset(criteo_df)
+    est = _dlrm_est(
+        [1000, 50], streaming="hybrid", shuffle=False, num_epochs=4
+    )
+    history = est.fit(ds)
+    assert len(history) == 4
+    assert history[-1]["train_loss"] < history[0]["train_loss"]
+    stats = est.stream_stats_
+    assert stats["cached_epochs"] == 3, stats  # only epoch 1 streamed
+    assert stats["bytes_uploaded"] > 0
+
+
 def test_streaming_hybrid_caches_segments(session, linear_df):
     """streaming="hybrid": epoch 1 streams and pins segments on device;
     later epochs scan from HBM (no re-upload). Loss trajectory must stay
