@@ -103,6 +103,7 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     # ownership transfer + stop: training runs with the ETL engine's CPUs
     # returned (the reference's stop_spark_after_conversion pattern)
     ds = dataframe_to_dataset(df, _use_owner=True)
+    etl_breakdown = _etl_breakdown(session.last_query_stats)
     raydp_tpu.stop_etl(cleanup_data=False, del_obj_holder=False)
     t_query = time.perf_counter() - t0
     t_etl = t_boot + t_query
@@ -139,6 +140,7 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
         lambda: pure_jax_scan_throughput(MLPRegressor(), mse, x, y, batch, epochs),
     )
     cmp["eval_sps"] = eval_throughput(est, ds, n_rows)
+    cmp["etl_breakdown"] = etl_breakdown
     cmp.update(
         fair_e2e_fields(pandas_taxi_etl, pdf, trained, t_boot, t_query, cmp)
     )
@@ -152,6 +154,25 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
         cmp["streaming_hybrid_sps"] / cmp["train_only_sps"], 4
     )
     return trained, t_gen, t_etl, cmp
+
+
+def _etl_breakdown(stats):
+    """Compact, JSON-ready view of the planner's last_query_stats: per-stage
+    task counts, dispatch mode, and the server-side read/compute/emit phase
+    split, plus the fusion decisions — so a regression in any layer of the
+    ETL data plane is attributable from BENCH_r*.json alone."""
+    stages = [
+        {
+            k: (round(v, 4) if isinstance(v, float) else v)
+            for k, v in stage.items()
+        }
+        for stage in stats.get("stages", [])
+    ]
+    return {
+        "seconds": round(stats.get("seconds", 0.0), 4),
+        "stages": stages,
+        "fusion": stats.get("fusion", []),
+    }
 
 
 def streaming_throughput(model, features, ds, trained, batch, epochs):
@@ -505,6 +526,7 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
     t0 = time.perf_counter()
     df = make_criteo_frame(session, source, parts=4)
     ds = dataframe_to_dataset(df, _use_owner=True)
+    etl_breakdown = _etl_breakdown(session.last_query_stats)
     raydp_tpu.stop_etl(cleanup_data=False, del_obj_holder=False)
     t_query = time.perf_counter() - t0
     t_etl = t_boot + t_query
@@ -550,6 +572,7 @@ def bench_dlrm(n_rows: int, batch: int, epochs: int):
         lambda: pure_jax_scan_throughput(model, bce, x, y, batch, epochs),
     )
     cmp["eval_sps"] = eval_throughput(est, ds, n_rows)
+    cmp["etl_breakdown"] = etl_breakdown
     cmp.update(
         fair_e2e_fields(pandas_criteo_etl, source, trained, t_boot, t_query, cmp)
     )

@@ -38,6 +38,7 @@ from raydp_tpu.cluster.common import (
     recv_frame,
     resolve_head_addr,
     rpc,
+    rpc_pooled,
     send_frame,
     wait_for_path,
 )
@@ -76,8 +77,25 @@ def session_dir() -> str:
     return _session_dir
 
 
+# head methods that must NOT ride the pooled transport: rpc_pooled retries
+# once on a reset connection, and a retry after the head already processed
+# the frame would double-execute these (a second create_actor spawns and
+# orphans a second OS process; a second add_node registers a ghost node)
+_NON_IDEMPOTENT_HEAD_METHODS = frozenset(
+    {"create_actor", "create_placement_group", "add_node",
+     "object_put_proxy_commit"}
+)
+
+
 def head_rpc(method: str, timeout: float = 60.0, **kwargs) -> Any:
-    return rpc(resolve_head_addr(session_dir()), (method, kwargs), timeout=timeout)
+    # pooled: the object/actor metadata plane is called on every block
+    # write/read, and a fresh connect + accept-thread per call costs ~ms —
+    # safe because the pool's one reconnect-retry only re-sends requests
+    # whose re-execution is harmless (the rest go one-shot)
+    addr = resolve_head_addr(session_dir())
+    if method in _NON_IDEMPOTENT_HEAD_METHODS:
+        return rpc(addr, (method, kwargs), timeout=timeout)
+    return rpc_pooled(addr, (method, kwargs), timeout=timeout)
 
 
 def init(
@@ -363,7 +381,7 @@ class ActorHandle:
         return RemoteMethod(self, item)
 
     def _record(self) -> Optional[ActorRecord]:
-        return rpc(
+        return rpc_pooled(
             resolve_head_addr(self._session_dir),
             ("get_actor", {"actor_id": self._actor_id}),
             timeout=30,

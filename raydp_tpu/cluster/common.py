@@ -199,6 +199,72 @@ def rpc(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) -> Any:
     raise value
 
 
+# ---------------------------------------------------------------------------
+# pooled RPC: persistent per-(thread, address) connections
+#
+# Control-plane servers serve multiple sequential frames per connection, so
+# hot callers (object register/lookup on every block write/read, task
+# dispatch bookkeeping) skip the ~ms connect + accept-thread cost per call.
+# Strictly sequential request/response per connection — concurrency comes
+# from each thread owning its own socket.
+# ---------------------------------------------------------------------------
+
+import threading as _threading
+
+_rpc_pool_tls = _threading.local()
+_POOL_MAX_ADDRS = 8  # old sessions' sockets must not accumulate per thread
+
+
+def _pool_drop(addr: str) -> None:
+    conns = getattr(_rpc_pool_tls, "conns", None)
+    if conns:
+        sock = conns.pop(addr, None)
+        if sock is not None:
+            try:
+                sock.close()
+            except OSError:
+                pass
+
+
+def rpc_pooled(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) -> Any:
+    """Request/response over a cached per-thread connection. A stale cached
+    connection (server restarted / closed idle) is dropped and the request
+    retried ONCE on a fresh connection — the same failure surface a fresh-
+    connection caller has. Callers routing non-idempotent requests should
+    use ``rpc`` instead."""
+    conns = getattr(_rpc_pool_tls, "conns", None)
+    if conns is None:
+        conns = _rpc_pool_tls.conns = {}
+    for attempt in (0, 1):
+        sock = conns.get(sock_path)
+        fresh = sock is None
+        try:
+            if sock is None:
+                if len(conns) >= _POOL_MAX_ADDRS:
+                    for stale in list(conns):
+                        _pool_drop(stale)
+                sock = connect(sock_path, timeout)
+                conns[sock_path] = sock
+            sock.settimeout(timeout)
+            send_frame(sock, request)
+            status, value = recv_frame(sock)
+            break
+        except socket.timeout:
+            # the server HAS the request and may still be processing it —
+            # retrying would double-execute (create_actor would leak a
+            # second process). Propagate like plain rpc(); the connection
+            # is poisoned (a late reply would desync the stream), so drop it.
+            _pool_drop(sock_path)
+            raise
+        except (ConnectionError, EOFError, OSError):
+            _pool_drop(sock_path)
+            if attempt or fresh:
+                raise
+    if status == "ok":
+        return value
+    raise value
+
+
 def wait_for_path(path: str, timeout: float, what: str) -> None:
     deadline = time.monotonic() + timeout
     while not os.path.exists(path):

@@ -637,12 +637,17 @@ class Head:
         skip actors already DEAD."""
         deadline = time.monotonic() + 5.0
         while time.monotonic() < deadline:
-            if actor.proc is None or actor.proc.poll() is not None:
+            # snapshot once per iteration: a concurrent respawn can set
+            # actor.proc = None between a check and a .poll() on the bare
+            # attribute, AttributeError-ing this reaper thread
+            proc = actor.proc
+            if proc is None or proc.poll() is not None:
                 with self.lock:
+                    proc = actor.proc
                     if (
                         actor.state != ActorState.DEAD
                         and not actor.pending_respawn
-                        and (actor.proc is None or actor.proc.poll() is not None)
+                        and (proc is None or proc.poll() is not None)
                     ):
                         self._on_actor_death(actor)
                 return
@@ -1044,23 +1049,37 @@ class _Handler(socketserver.BaseRequestHandler):
 
             if not verify_token(self.request, token):
                 return
-        try:
-            method, kwargs = recv_frame(self.request)
-        except (ConnectionError, EOFError):
-            return
-        try:
-            fn = getattr(head, f"handle_{method}", None)
-            if fn is None:
-                raise ClusterError(f"unknown head method {method!r}")
-            result = fn(**kwargs)
-            reply = ("ok", result)
-        except BaseException as exc:  # noqa: BLE001 - propagate to caller
-            exc.__cause__ = None
-            reply = ("err", exc)
-        try:
-            send_frame(self.request, reply)
-        except (ConnectionError, BrokenPipeError):
-            pass
+        # serve frames until the peer hangs up: one-shot clients close after
+        # the first reply (loop exits on EOF), pooled clients keep the
+        # connection for their lifetime and skip per-call connect+accept
+        while True:
+            try:
+                method, kwargs = recv_frame(self.request)
+            except (ConnectionError, EOFError, OSError):
+                return
+            try:
+                fn = getattr(head, f"handle_{method}", None)
+                if fn is None:
+                    raise ClusterError(f"unknown head method {method!r}")
+                result = fn(**kwargs)
+                reply = ("ok", result)
+            except BaseException as exc:  # noqa: BLE001 - propagate to caller
+                exc.__cause__ = None
+                reply = ("err", exc)
+            try:
+                send_frame(self.request, reply)
+            except (ConnectionError, BrokenPipeError, OSError):
+                return
+            except Exception:
+                # unpicklable reply: report it without severing the pooled
+                # connection (the CALLER still needs a frame)
+                try:
+                    send_frame(
+                        self.request,
+                        ("err", ClusterError("head reply could not be serialized")),
+                    )
+                except (ConnectionError, BrokenPipeError, OSError):
+                    return
 
 
 class _Server(socketserver.ThreadingUnixStreamServer):

@@ -89,53 +89,61 @@ def _serve(
 
     class Handler(socketserver.BaseRequestHandler):
         def handle(self):
-            try:
-                method, args, kwargs, no_reply = recv_frame(self.request)
-            except (ConnectionError, EOFError):
-                return
-            if method == "__ping__":
-                send_frame(self.request, ("ok", "pong"))
-                return
-            if method == "__shutdown__":
-                send_frame(self.request, ("ok", True))
-                stop_event.set()
-                return
-
-            def run():
+            # frames loop until the peer hangs up: one-shot callers
+            # (ActorFuture closes after its reply) exit on EOF; pooled
+            # clients reuse the connection for sequential calls
+            while True:
                 try:
-                    fn = getattr(instance, method)
-                    return ("ok", fn(*args, **kwargs))
-                except BaseException as exc:  # noqa: BLE001
-                    tb = traceback.format_exc()
+                    method, args, kwargs, no_reply = recv_frame(self.request)
+                except (ConnectionError, EOFError, OSError):
+                    return
+                if method == "__ping__":
+                    send_frame(self.request, ("ok", "pong"))
+                    continue
+                if method == "__shutdown__":
+                    send_frame(self.request, ("ok", True))
+                    stop_event.set()
+                    return
+
+                # bind the request into the closure: the frame loop rebinds
+                # method/args/kwargs on the NEXT recv, and a pooled client's
+                # no_reply call must not race its successor into running
+                # with the successor's arguments
+                def run(method=method, args=args, kwargs=kwargs):
                     try:
-                        cloudpickle.dumps(exc)
-                    except Exception:
-                        exc = RuntimeError(f"{type(exc).__name__}: {exc}")
-                    exc.remote_traceback = tb  # type: ignore[attr-defined]
-                    return ("err", exc)
+                        fn = getattr(instance, method)
+                        return ("ok", fn(*args, **kwargs))
+                    except BaseException as exc:  # noqa: BLE001
+                        tb = traceback.format_exc()
+                        try:
+                            cloudpickle.dumps(exc)
+                        except Exception:
+                            exc = RuntimeError(f"{type(exc).__name__}: {exc}")
+                        exc.remote_traceback = tb  # type: ignore[attr-defined]
+                        return ("err", exc)
 
-            future = pool.submit(run)
-            if no_reply:
-                return
-            reply = future.result()
-            try:
-                send_frame(self.request, reply)
-            except (ConnectionError, BrokenPipeError):
-                pass
-            except Exception as exc:  # unpicklable result: report, don't sever
+                future = pool.submit(run)
+                if no_reply:
+                    continue
+                reply = future.result()
                 try:
-                    send_frame(
-                        self.request,
-                        (
-                            "err",
-                            RuntimeError(
-                                f"result of {method}() could not be serialized: "
-                                f"{type(exc).__name__}: {exc}"
+                    send_frame(self.request, reply)
+                except (ConnectionError, BrokenPipeError, OSError):
+                    return
+                except Exception as exc:  # unpicklable result: report, don't sever
+                    try:
+                        send_frame(
+                            self.request,
+                            (
+                                "err",
+                                RuntimeError(
+                                    f"result of {method}() could not be serialized: "
+                                    f"{type(exc).__name__}: {exc}"
+                                ),
                             ),
-                        ),
-                    )
-                except (ConnectionError, BrokenPipeError):
-                    pass
+                        )
+                    except (ConnectionError, BrokenPipeError, OSError):
+                        return
 
     if use_tcp:
         # agent-spawned actors must be reachable across hosts; peers

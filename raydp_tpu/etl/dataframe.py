@@ -488,6 +488,18 @@ class DataFrame:
     def num_partitions(self) -> int:
         return self._session._planner.partition_count(self._plan)
 
+    def explain(self, mode: str = "text"):
+        """Inspectable physical plan: how the narrow chain fuses and where
+        stages break. ``mode="info"`` returns the structured dict (stage
+        tree with ``narrow_ops``/``fused_ops``/``output_partitions``);
+        ``"text"`` (default) prints and returns the formatted tree."""
+        planner = self._session._planner
+        if mode == "info":
+            return planner.explain_info(self._plan)
+        text = planner.format_explain(self._plan)
+        print(text)
+        return text
+
     def write_parquet(self, path: str) -> int:
         results = self._session._planner.execute_action(
             self._plan, T.OutputSpec("parquet", path=path)

@@ -403,6 +403,129 @@ def _value_type(value) -> pa.DataType:
     return value.type
 
 
+# ---------------------------------------------------------------------------
+# Fusion support: substitution + shared-subexpression evaluation.
+#
+# The planner's project-fusion pass collapses Project(Project(x)) chains into
+# one Project by substituting the inner project's (name → expr) map into the
+# outer expressions. A substituted expression can appear at several use sites
+# (e.g. dx feeding both the dx output column and the dist formula), so
+# substitution inserts ONE SharedExpr node per inner column and evaluation
+# memoizes per use: the fused plan does exactly the work of the unfused one.
+# ---------------------------------------------------------------------------
+
+import threading as _threading
+
+_shared_eval_tls = _threading.local()
+
+
+class _SharedEvalCache:
+    """Context manager scoping one memo dict to one Project application (the
+    cache must not leak across tables or threads — executor actors run tasks
+    concurrently, and thread-local scoping keeps each task's memo private)."""
+
+    def __enter__(self):
+        self._prev = getattr(_shared_eval_tls, "cache", None)
+        _shared_eval_tls.cache = {}
+        return self
+
+    def __exit__(self, *exc):
+        _shared_eval_tls.cache = self._prev
+        return False
+
+
+def shared_eval_cache() -> _SharedEvalCache:
+    return _SharedEvalCache()
+
+
+@dataclass(eq=False)
+class SharedExpr(Expr):
+    """A subexpression referenced from several places in a fused projection.
+    Inside a ``shared_eval_cache()`` scope it evaluates its child once and
+    serves every other use from the memo; outside one it is transparent."""
+
+    child: Expr
+
+    def evaluate(self, table: pa.Table):
+        cache = getattr(_shared_eval_tls, "cache", None)
+        if cache is None:
+            return self.child.evaluate(table)
+        key = id(self)
+        if key not in cache:
+            cache[key] = self.child.evaluate(table)
+        return cache[key]
+
+    def name_hint(self) -> str:
+        return self.child.name_hint()
+
+    def references(self) -> List[str]:
+        return self.child.references()
+
+
+class CannotSubstitute(TypeError):
+    """Raised for expression node types substitution does not understand —
+    the fusion pass catches it and leaves the chain unfused (correctness
+    over fusion for user-defined Expr subclasses)."""
+
+
+def substitute(expr: Expr, mapping: Dict[str, Expr]) -> Expr:
+    """Rebuild ``expr`` with every ColumnRef replaced per ``mapping``
+    (references absent from the mapping stay as-is). Mapping values are
+    inserted by reference, NOT recursed into — they are already expressed
+    over the base table, and sharing the node object is what lets
+    SharedExpr de-duplicate their evaluation."""
+    if isinstance(expr, ColumnRef):
+        return mapping.get(expr.name, expr)
+    if isinstance(expr, (Literal, SharedExpr)):
+        return expr
+    if isinstance(expr, Alias):
+        return Alias(substitute(expr.child, mapping), expr.name)
+    if isinstance(expr, Cast):
+        return Cast(substitute(expr.child, mapping), expr.dtype)
+    if isinstance(expr, BinaryOp):
+        return BinaryOp(
+            expr.op,
+            substitute(expr.left, mapping),
+            substitute(expr.right, mapping),
+        )
+    if isinstance(expr, UnaryOp):
+        return UnaryOp(expr.op, substitute(expr.child, mapping))
+    if isinstance(expr, IsIn):
+        return IsIn(substitute(expr.child, mapping), expr.values)
+    if isinstance(expr, Function):
+        return Function(
+            expr.fn, [substitute(a, mapping) for a in expr.args], expr.options
+        )
+    if isinstance(expr, When):
+        return When(
+            [
+                (substitute(c, mapping), substitute(v, mapping))
+                for c, v in expr.branches
+            ],
+            None if expr.default is None else substitute(expr.default, mapping),
+        )
+    if isinstance(expr, Udf):
+        return Udf(expr.func, [substitute(a, mapping) for a in expr.args], expr.dtype)
+    raise CannotSubstitute(type(expr).__name__)
+
+
+def merge_projects(
+    inner: List[Tuple[str, Expr]], outer: List[Tuple[str, Expr]]
+) -> List[Tuple[str, Expr]]:
+    """Compose two adjacent projections into one: the outer's expressions
+    rewritten over the inner's inputs. Computed inner columns are wrapped in
+    ONE SharedExpr each so multi-use sites evaluate them once."""
+    mapping: Dict[str, Expr] = {}
+    for name, expr in inner:
+        if isinstance(expr, (ColumnRef, Literal, SharedExpr)):
+            mapping[name] = expr
+        elif isinstance(expr, Alias) and isinstance(expr.child, (ColumnRef, Literal)):
+            mapping[name] = expr.child
+        else:
+            mapping[name] = SharedExpr(expr)
+    return [(name, substitute(expr, mapping)) for name, expr in outer]
+
+
 def _as_array(value, num_rows: int):
     """Broadcast scalars so struct/case_when see equal-length arrays."""
     if isinstance(value, pa.Scalar):

@@ -239,10 +239,40 @@ def repeat(c: ColumnLike, n: int) -> Expr:
 
 
 def instr(c: ColumnLike, substr: str) -> Expr:
-    """1-based index of the first occurrence; 0 when absent (Spark semantics;
-    arrow's find_substring is 0-based with -1 absent)."""
-    found = Function("find_substring", [_c(c)], options={"pattern": substr})
-    return Function("add", [found, Literal(1)])
+    """1-based CHARACTER index of the first occurrence; 0 when absent
+    (Spark semantics). Arrow's find_substring reports BYTE offsets, which
+    drift right of the character position whenever a multi-byte character
+    precedes the match — all-ASCII batches (where the two coincide) keep
+    the vectorized kernel; anything else takes a character-exact row-wise
+    fallback. Null in → null out either way."""
+    import pyarrow as pa
+    import pyarrow.compute as pc
+
+    pattern = str(substr)
+
+    def _fn(values):
+        arr = (
+            values
+            if isinstance(values, (pa.Array, pa.ChunkedArray))
+            else pa.array(values)
+        )
+        if pattern.isascii():
+            ascii_only = pc.min(  # null rows don't veto the fast path
+                pc.string_is_ascii(arr).cast(pa.int8())
+            ).as_py()
+            if ascii_only is None or ascii_only == 1:
+                return pc.add(
+                    pc.find_substring(arr, pattern), pa.scalar(1)
+                )
+        return np.array(
+            [
+                None if v is None else str(v).find(pattern) + 1
+                for v in arr.to_pylist()
+            ],
+            dtype=object,
+        )
+
+    return Udf(_fn, [_c(c)], dtype="int32")
 
 
 def locate(substr: str, c: ColumnLike, pos: int = 1) -> Expr:
@@ -373,6 +403,16 @@ def _java_datetime_format(fmt: str) -> str:
         else:
             for java, strf in _JAVA_TO_STRFTIME:
                 part = part.replace(java, strf)
+            # any alphabetic run left over is an untranslated Java token
+            # (e.g. MMM): emitting it would silently produce half-translated
+            # output like '%d %mM %Y' — reject it the way the SSS guard does
+            leftover = _re.sub(r"%[A-Za-z]", "", part)
+            stray = _re.search(r"[A-Za-z]+", leftover)
+            if stray:
+                raise NotImplementedError(
+                    f"unsupported datetime pattern token {stray.group()!r} "
+                    f"in {fmt!r}"
+                )
             out.append(part)
     return "".join(out)
 
@@ -410,7 +450,8 @@ def from_unixtime(c: ColumnLike, fmt: str = "yyyy-MM-dd HH:mm:ss") -> Expr:
 
 
 def date_add(c: ColumnLike, days: int) -> Expr:
-    """Shift a date/timestamp by whole days (Spark date_add)."""
+    """Shift a date/timestamp by whole days and return a DATE (Spark
+    date_add returns DateType — time-of-day is truncated, not carried)."""
 
     def _fn(values):
         arr = np.asarray(values)
@@ -418,7 +459,7 @@ def date_add(c: ColumnLike, days: int) -> Expr:
             return arr + np.timedelta64(int(days), "D")
         raise TypeError(f"date_add expects a date/timestamp column, got {arr.dtype}")
 
-    return Udf(_fn, [_c(c)])
+    return Udf(_fn, [_c(c)], dtype="date")
 
 
 def date_sub(c: ColumnLike, days: int) -> Expr:

@@ -47,10 +47,18 @@ class Planner:
         executors: Optional[Sequence[Any]] = None,
         default_parallelism: int = 4,
         owner: Optional[str] = None,
+        executor_slots: int = 1,
     ):
         self.executors = list(executors or [])
         self.default_parallelism = max(1, default_parallelism)
         self.owner = owner  # ownership target for produced blocks
+        # project-fusion rewrite (collapse adjacent Projects into one):
+        # tests flip this off to verify fused == unfused byte-identically
+        self.fuse_projects = True
+        # per-executor parallel task slots (the session sets this to
+        # executor_cores, matching the executor-side run_tasks thread pool);
+        # sizes the reply-timeout budget of batched dispatches
+        self.executor_slots = max(1, int(executor_slots))
         # observability: rolling stats of the most recent query (SURVEY §5:
         # first-class step timing; the reference defers everything to the
         # Ray/Spark dashboards). Stage logs are thread-local so concurrent
@@ -86,6 +94,8 @@ class Planner:
         self._tls = threading.local()
         self.scale_hook = None
         self._inflight_lock = threading.Lock()
+        self.__dict__.setdefault("fuse_projects", True)
+        self.__dict__.setdefault("executor_slots", 1)
 
     # ------------------------------------------------------------------
     # task submission
@@ -193,15 +203,24 @@ class Planner:
                 hook(len(specs))
             except Exception:
                 pass  # allocation policy failures must never fail the query
+        batched = False
         try:
             if not self.executors:
-                return [T.run_task(s) for s in specs]
+                results = [T.run_task(s) for s in specs]
+                return results
             prefs = self._preferred_executors(specs)
-            futures = [
-                (self._dispatch(spec, i, 0, prefs[i]), spec, i)
-                for i, spec in enumerate(specs)
-            ]
-            results = self._gather(futures, specs)
+            # one-dispatch batch path: a stage wider than the pool's task
+            # slots ships each executor its whole task list in ONE
+            # run_tasks RPC instead of one round trip per task
+            if len(specs) > len(self.executors):
+                batched = True
+                results = self._submit_batched(specs, prefs)
+            else:
+                futures = [
+                    (self._dispatch(spec, i, 0, prefs[i]), spec, i)
+                    for i, spec in enumerate(specs)
+                ]
+                results = self._gather(futures, specs)
             return results
         finally:
             if hook is not None:
@@ -215,6 +234,7 @@ class Planner:
                     "locality_preferred": sum(
                         1 for p in prefs if p is not None
                     ),
+                    "dispatch": "batched" if batched else "per_task",
                 }
                 try:
                     # executor-side wall time per task: lets query stats
@@ -222,9 +242,79 @@ class Planner:
                     entry["server_seconds"] = round(
                         sum(r.server_seconds for r in results), 6
                     )
+                    entry["read_s"] = round(
+                        sum(r.read_seconds for r in results), 6
+                    )
+                    entry["compute_s"] = round(
+                        sum(r.compute_seconds for r in results), 6
+                    )
+                    entry["emit_s"] = round(
+                        sum(r.emit_seconds for r in results), 6
+                    )
                 except (NameError, AttributeError):
-                    pass  # driver-local fallback path has no server timing
+                    pass  # dispatch raised before results existed
                 log.append(entry)
+
+    def _submit_batched(
+        self, specs: List[T.TaskSpec], prefs: List[Optional[int]]
+    ) -> List[T.TaskResult]:
+        """Group tasks by executor (locality-preferred, round-robin filled)
+        and dispatch each group as ONE run_tasks call — per-task actor round
+        trips collapse to one per executor. A group whose executor dies
+        mid-flight falls back to the per-task retry ladder."""
+        n = len(self.executors)
+        groups: List[List[int]] = [[] for _ in range(n)]
+        # preferences are honored STRICTLY — the per-task path dispatches to
+        # the preferred executor first too, and locality tests pin outputs
+        # to the data's node; unpreferred tasks balance onto the emptiest
+        # groups
+        for i in range(len(specs)):
+            p = prefs[i]
+            if p is not None:
+                groups[p % n].append(i)
+        for i in range(len(specs)):
+            if prefs[i] is None:
+                groups[min(range(n), key=lambda g: len(groups[g]))].append(i)
+        futures = []
+        fallback: List[int] = []
+        for idx, group in enumerate(groups):
+            if not group:
+                continue
+            # the per-task path gives every task its own 300s reply budget;
+            # a batch's single reply must get the equivalent wall budget —
+            # tasks run executor_slots wide inside run_tasks
+            waves = -(-len(group) // max(1, self.executor_slots))
+            try:
+                futures.append(
+                    (
+                        self.executors[idx].run_tasks.options(
+                            timeout=300.0 * waves
+                        ).remote([specs[i] for i in group]),
+                        group,
+                    )
+                )
+            except _ActorDied:
+                fallback.extend(group)
+        results: List[Optional[T.TaskResult]] = [None] * len(specs)
+        for future, group in futures:
+            try:
+                batch = future.result()
+                for i, r in zip(group, batch):
+                    results[i] = r
+            except (ConnectionError, EOFError, _ActorDied):
+                fallback.extend(group)
+        if fallback:
+            # per-task retry ladder over a DENSE spec list (_gather indexes
+            # positionally), then scatter back to stage positions
+            dense_specs = [specs[i] for i in fallback]
+            retry_futures = [
+                (self._dispatch(dense_specs[j], fallback[j], 1), dense_specs[j], j)
+                for j in range(len(fallback))
+            ]
+            retried = self._gather(retry_futures, dense_specs)
+            for j, i in enumerate(fallback):
+                results[i] = retried[j]
+        return results  # type: ignore[return-value]
 
     def _gather(self, futures, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
         results: List[Optional[T.TaskResult]] = [None] * len(specs)
@@ -385,6 +475,116 @@ class Planner:
                 raise TypeError(type(n).__name__)
         return out
 
+    def _fuse_chain(self, chain: List[lp.PlanNode]) -> List[lp.PlanNode]:
+        """The fusion rewrite: collapse ADJACENT Project nodes into one by
+        substituting the inner projection's (name → expr) map into the outer
+        expressions (shared subexpressions evaluate once via SharedExpr).
+        A chain of withColumn/select steps then executes as a single
+        projection per partition instead of materializing each step's full
+        intermediate table. Purely a rewrite — unknown expression types
+        leave the chain unfused."""
+        if not getattr(self, "fuse_projects", True) or len(chain) < 2:
+            return chain
+        from raydp_tpu.etl.expressions import CannotSubstitute, merge_projects
+
+        fused: List[lp.PlanNode] = []
+        for node in chain:
+            if (
+                fused
+                and isinstance(node, lp.Project)
+                and isinstance(fused[-1], lp.Project)
+            ):
+                try:
+                    fused[-1] = lp.Project(
+                        None,  # type: ignore[arg-type]
+                        merge_projects(fused[-1].columns, node.columns),
+                    )
+                    continue
+                except CannotSubstitute:
+                    pass  # user-defined Expr subclass: keep the step separate
+            fused.append(node)
+        return fused
+
+    def _prepare_chain(self, chain: List[lp.PlanNode]) -> List[lp.PlanNode]:
+        """Strip + fuse the narrow chain for shipping; records each fusion
+        decision for last_query_stats."""
+        shipped = self._strip_children(chain)
+        fused = self._fuse_chain(shipped)
+        if len(fused) != len(shipped):
+            flog = getattr(self._tls, "fusion_log", None)
+            if flog is not None:
+                flog.append(
+                    {"narrow_ops": len(shipped), "fused_ops": len(fused)}
+                )
+        return fused
+
+    # ------------------------------------------------------------------
+    # plan inspection (DataFrame.explain)
+    # ------------------------------------------------------------------
+
+    @staticmethod
+    def _describe_op(node: lp.PlanNode) -> str:
+        if isinstance(node, lp.Project):
+            return f"Project[{', '.join(name for name, _ in node.columns)}]"
+        if isinstance(node, lp.Filter):
+            return f"Filter[{node.predicate.name_hint()}]"
+        if isinstance(node, lp.PartitionHead):
+            return f"PartitionHead[{node.n}]"
+        if isinstance(node, lp.Sample):
+            return f"Sample[{node.fraction}]"
+        return type(node).__name__
+
+    def explain_info(self, node: lp.PlanNode) -> dict:
+        """Structural view of the physical execution: the narrow chain as
+        written, the chain after fusion, the stage's base (source or wide
+        op), and recursively the wide children. One dict per stage-producing
+        subplan — what the fusion test asserts against."""
+        base, chain = self._split_narrow(node)
+        stripped = self._strip_children(chain)
+        fused = self._fuse_chain(stripped)
+        try:
+            parts = self.partition_count(node)
+        except TypeError:
+            parts = None
+        if isinstance(base, lp.Union):
+            children = list(base.inputs)
+        else:
+            children = base.children()
+        return {
+            "base": type(base).__name__,
+            "narrow_ops": [type(n).__name__ for n in stripped],
+            "fused_ops": [self._describe_op(n) for n in fused],
+            "output_partitions": parts,
+            "children": [self.explain_info(c) for c in children],
+        }
+
+    def format_explain(self, node: lp.PlanNode) -> str:
+        info = self.explain_info(node)
+        lines: List[str] = []
+
+        def _fmt(entry: dict, depth: int) -> None:
+            pad = "  " * depth
+            parts = entry["output_partitions"]
+            head = f"{pad}* {entry['base']}"
+            if parts is not None:
+                head += f" → {parts} partition(s)"
+            lines.append(head)
+            if entry["narrow_ops"]:
+                fused_note = ""
+                if len(entry["fused_ops"]) != len(entry["narrow_ops"]):
+                    fused_note = (
+                        f"  (fused {len(entry['narrow_ops'])} narrow ops"
+                        f" → {len(entry['fused_ops'])})"
+                    )
+                lines.append(
+                    f"{pad}  chain: {' → '.join(entry['fused_ops'])}{fused_note}"
+                )
+            for child in entry["children"]:
+                _fmt(child, depth + 1)
+
+        _fmt(info, 0)
+        return "\n".join(lines)
+
     def materialize(self, node: lp.PlanNode, storage: str = "auto") -> Materialized:
         """Execute to object-store blocks (one per partition). ``storage``
         selects the block tier ("disk" = persist to each executor node's
@@ -411,15 +611,19 @@ class Planner:
             # stages contribute to the enclosing query's stats
         start = time.perf_counter()
         self._tls.stage_log = []
+        self._tls.fusion_log = []
         try:
             results = run()
         finally:
             stages = self._tls.stage_log
+            fusion = self._tls.fusion_log
             self._tls.stage_log = None
+            self._tls.fusion_log = None
         self.last_query_stats = {
             "seconds": time.perf_counter() - start,
             "output_partitions": len(results),
             "stages": stages,
+            "fusion": fusion,
         }
         return results
 
@@ -434,7 +638,7 @@ class Planner:
         inputs) never share an index — indices seed RNGs and name parquet
         parts, so collisions silently lose data."""
         base, chain = self._split_narrow(node)
-        shipped = self._strip_children(chain)
+        shipped = self._prepare_chain(chain)
 
         if isinstance(base, (lp.ArrowSource, lp.RangeSource, lp.ParquetSource, lp.CsvSource)):
             reads = self._source_reads(base)

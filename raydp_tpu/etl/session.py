@@ -64,6 +64,10 @@ class EtlSession:
         self.executor_cores = executor_cores
         self.executor_memory = parse_memory_size(executor_memory)
         self.configs = dict(configs or {})
+        # executors parallelize batched run_tasks calls with this many
+        # threads (the per-task dispatch path gets the same width from the
+        # actor's max_concurrency pool)
+        self.configs.setdefault("etl.executor.cores", executor_cores)
         self.default_parallelism = int(
             self.configs.get(
                 "etl.default.parallelism", max(2, num_executors * executor_cores)
@@ -182,7 +186,9 @@ class EtlSession:
         self._next_executor_id = num_executors
 
         self._planner = Planner(
-            self.executors, default_parallelism=self.default_parallelism
+            self.executors,
+            default_parallelism=self.default_parallelism,
+            executor_slots=executor_cores,
         )
 
         # dynamic allocation (reference: Spark's doRequestTotalExecutors /
@@ -466,7 +472,7 @@ class EtlSession:
                         break
                 except Exception:
                     break
-                time.sleep(0.01)  # the head reaps intentional kills in ~ms
+                time.sleep(0.002)  # the head reaps intentional kills in ~ms
         if cleanup_data and del_obj_holder:
             try:
                 self.master.kill(no_restart=True)

@@ -152,6 +152,12 @@ class TaskResult:
     # server-side wall time of the task body (read→compute→emit), for query
     # stats: lets the driver tell executor compute from dispatch/transport
     server_seconds: float = 0.0
+    # per-phase breakdown of server_seconds (read+merge / narrow chain /
+    # output emit) — aggregated per stage into last_query_stats so ETL
+    # regressions are attributable to a layer, not just a total
+    read_seconds: float = 0.0
+    compute_seconds: float = 0.0
+    emit_seconds: float = 0.0
 
 
 # ---------------------------------------------------------------------------
@@ -216,12 +222,17 @@ def schema_ipc_bytes(schema: pa.Schema) -> bytes:
 
 def apply_narrow(table: pa.Table, node: lp.PlanNode, partition_index: int) -> pa.Table:
     if isinstance(node, lp.Project):
+        from raydp_tpu.etl.expressions import shared_eval_cache
+
         arrays, names = [], []
         n = table.num_rows
-        for name, expr in node.columns:
-            value = expr.evaluate(table)
-            arrays.append(_as_array(value, n))
-            names.append(name)
+        # the memo scope makes fused projections evaluate each shared
+        # subexpression (a column consumed by several later formulas) once
+        with shared_eval_cache():
+            for name, expr in node.columns:
+                value = expr.evaluate(table)
+                arrays.append(_as_array(value, n))
+                names.append(name)
         return pa.Table.from_arrays(arrays, names=names)
     if isinstance(node, lp.Filter):
         mask = node.predicate.evaluate(table)
@@ -745,10 +756,20 @@ def _read_and_merge(spec: TaskSpec) -> pa.Table:
 def run_task(spec: TaskSpec) -> TaskResult:
     if os.environ.get("RAYDP_TPU_TASK_TRACE"):
         return _run_task_traced(spec)
+    import time
+
+    t0 = time.perf_counter()
     table = _read_and_merge(spec)
+    t1 = time.perf_counter()
     for node in spec.chain:
         table = apply_narrow(table, node, spec.partition_index)
-    return _emit(table, spec)
+    t2 = time.perf_counter()
+    result = _emit(table, spec)
+    t3 = time.perf_counter()
+    result.read_seconds = t1 - t0
+    result.compute_seconds = t2 - t1
+    result.emit_seconds = t3 - t2
+    return result
 
 
 _TRACE_SEQ = iter(range(1 << 62))  # per-process trace-file sequence

@@ -106,36 +106,69 @@ def _mesh_single_device(mesh):
 
 
 class PrefetchingDeviceIterator:
-    """Wraps a host batch iterator; keeps one batch ahead on device.
+    """Wraps a host batch iterator; keeps ``depth`` batches ahead on device.
 
-    jax device transfers are asynchronous, so simply issuing the device_put for
-    the next batch before yielding the current one overlaps H2D with compute.
+    jax device transfers are asynchronous, so issuing the device_put for the
+    next batch(es) before yielding the current one overlaps H2D with compute.
+    ``depth=1`` is classic double buffering; deeper prefetch rides out bursty
+    producers at the cost of ``depth`` extra device-resident batches.
     """
 
-    def __init__(self, host_iter: Iterator, mesh, axis: str = "data"):
+    def __init__(self, host_iter: Iterator, mesh, axis: str = "data",
+                 depth: int = 1):
+        from collections import deque
+
         self._host_iter = iter(host_iter)
         self._mesh = mesh
         self._axis = axis
-        self._next = None
-        self._advance()
+        self._depth = max(1, int(depth))
+        self._pending = deque()
+        self._exhausted = False
+        self._fill()
 
-    def _advance(self):
-        try:
-            batch = next(self._host_iter)
-        except StopIteration:
-            self._next = None
-            return
-        self._next = device_put_batch(batch, self._mesh, self._axis)
+    def _fill(self):
+        while not self._exhausted and len(self._pending) < self._depth:
+            try:
+                batch = next(self._host_iter)
+            except StopIteration:
+                self._exhausted = True
+                return
+            self._pending.append(
+                device_put_batch(batch, self._mesh, self._axis)
+            )
 
     def __iter__(self):
         return self
 
     def __next__(self):
-        if self._next is None:
+        if not self._pending:
             raise StopIteration
-        current = self._next
-        self._advance()
+        current = self._pending.popleft()
+        self._fill()
         return current
+
+
+def coalesce_segment(features, labels, batch_size: int):
+    """Shape one COALESCED host super-batch (``k·B [+tail]`` rows pulled as
+    a single slice) into scan-ready stacked arrays: trim to a whole number
+    of batches and reshape ``[k·B, ...] → [k, B, ...]`` — zero-copy for
+    contiguous inputs, where per-batch ``np.stack`` would copy every
+    segment and pay a Python loop per batch. Returns ``(xb, yb, k)``;
+    ``k == 0`` when fewer than one full batch remains (callers drop the
+    tail — drop_last semantics at batch granularity)."""
+    from raydp_tpu.exchange.features import f0, fmap
+
+    n = len(f0(features))
+    k = n // batch_size
+    if k == 0:
+        return None, None, 0
+
+    def _r(a):
+        a = np.asarray(a)
+        return a[: k * batch_size].reshape((k, batch_size) + a.shape[1:])
+
+    yb = None if labels is None else _r(labels)
+    return fmap(_r, features), yb, k
 
 
 def dataset_batches_on_device(

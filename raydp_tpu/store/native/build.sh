@@ -4,5 +4,10 @@
 set -e
 cd "$(dirname "$0")"
 tmp="libraydp_store.so.tmp.$$"
-g++ -O2 -fPIC -shared -std=c++17 -o "$tmp" store.cpp
+# -lrt: shm_open/shm_unlink live in librt on pre-2.34 glibc. Without the
+# explicit link the library only loads in processes where something else
+# already pulled librt in — light (python -S) actors that cold-start
+# without the zygote template have no such luck and dlopen fails with
+# "undefined symbol: shm_unlink".
+g++ -O2 -fPIC -shared -std=c++17 -o "$tmp" store.cpp -lrt
 mv -f "$tmp" libraydp_store.so

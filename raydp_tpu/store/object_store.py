@@ -71,7 +71,17 @@ def _load_native() -> ctypes.CDLL:
                         check=True,
                         capture_output=True,
                     )
-        lib = ctypes.CDLL(_LIB_PATH)
+        try:
+            lib = ctypes.CDLL(_LIB_PATH)
+        except OSError as exc:
+            if "undefined symbol" not in str(exc):
+                raise
+            # a libraydp_store.so built without -lrt resolves shm_* only in
+            # processes where librt is already mapped (full interpreters
+            # load it via numpy/jax deps; cold python -S actors don't) —
+            # preload it globally and retry before giving up
+            ctypes.CDLL("librt.so.1", mode=ctypes.RTLD_GLOBAL)
+            lib = ctypes.CDLL(_LIB_PATH)
         lib.rtpu_shm_create.restype = ctypes.c_void_p
         lib.rtpu_shm_create.argtypes = [ctypes.c_char_p, ctypes.c_uint64]
         lib.rtpu_shm_finalize.restype = ctypes.c_int

@@ -757,11 +757,14 @@ def test_scalar_function_batch_round5(session):
     assert out["fut"].tolist() == [
         "1970-01-01 00:00:00", "2020-09-13 12:26:40", "1970-01-02 00:00:00"
     ]
+    # Spark date_add/date_sub return DATE: time-of-day truncated
     assert (
-        pd.to_datetime(out["da"]) - pdf["ts"] == pd.Timedelta(days=10)
+        pd.to_datetime(out["da"])
+        == (pdf["ts"] + pd.Timedelta(days=10)).dt.normalize()
     ).all()
     assert (
-        pdf["ts"] - pd.to_datetime(out["ds"]) == pd.Timedelta(days=1)
+        pd.to_datetime(out["ds"])
+        == (pdf["ts"] - pd.Timedelta(days=1)).dt.normalize()
     ).all()
     np.testing.assert_allclose(out["sh"], np.sinh(pdf["x"]), rtol=1e-12)
     np.testing.assert_allclose(out["deg"], np.degrees(pdf["x"]), rtol=1e-12)
@@ -1046,3 +1049,87 @@ def test_pivot_edges(session):
     )
     assert list(e.columns) == ["jan", "dec"]
     assert pd.isna(e.loc[0, "dec"])  # absent value → null column, not drop
+
+
+def test_instr_locate_character_positions(session):
+    """Spark instr/locate are 1-based CHARACTER positions. Arrow's
+    find_substring reports BYTE offsets, which drift on any multi-byte
+    prefix: in 'héllo wörld' the substring 'wörld' is the 7th character
+    but the 8th byte (é is 2 bytes in UTF-8)."""
+    pdf = pd.DataFrame({"s": ["héllo wörld", "ascii world", None, "wörld"]})
+    df = session.from_pandas(pdf, num_partitions=2)
+    out = (
+        df.with_column("pos", F.locate("wörld", "s"))
+        .with_column("ascii_pos", F.instr("s", "world"))
+        .to_pandas()
+    )
+    assert out["pos"].tolist()[:2] == [7, 0]
+    assert out["pos"].tolist()[3] == 1
+    assert pd.isna(out["pos"][2])  # null in → null out
+    assert out["ascii_pos"].tolist()[:2] == [0, 7]
+
+
+def test_datetime_format_rejects_untranslated_tokens(session):
+    """A Java pattern token without a strftime translation (MMM) must fail
+    loudly, not half-translate ('dd MMM yyyy' → '%d %mM %Y')."""
+    pdf = pd.DataFrame({"ts": pd.to_datetime(["2020-03-15 10:11:12"])})
+    df = session.from_pandas(pdf, num_partitions=1)
+    with pytest.raises(NotImplementedError, match="M"):
+        df.with_column("bad", F.date_format("ts", "dd MMM yyyy")).to_pandas()
+    # quoted literals still pass through untouched
+    ok = df.with_column(
+        "ok", F.date_format("ts", "yyyy-MM-dd'T'HH:mm:ss")
+    ).to_pandas()
+    assert ok["ok"][0] == "2020-03-15T10:11:12"
+
+
+def test_fusion_single_task_per_partition(session):
+    """The fusion pass: a project→filter→withColumn chain executes as ONE
+    task per partition (single stage, adjacent Projects collapsed), and the
+    fused plan's results are byte-identical to the unfused path."""
+    pdf = pd.DataFrame(
+        {
+            "a": np.arange(30, dtype=np.float64),
+            "b": np.arange(30, dtype=np.float64) * 2.0,
+        }
+    )
+    df = session.from_pandas(pdf, num_partitions=3)
+    chain = (
+        df.select("a", "b")
+        .with_column("c", F.col("a") + F.col("b"))
+        .with_column("d", F.col("c") * 2.0)
+        .filter(F.col("a") >= 4.0)
+        .with_column("e", F.col("d") - F.col("a"))
+    )
+    info = chain.explain(mode="info")
+    # single stage over the source: no wide children
+    assert info["children"] == []
+    assert info["base"] == "ArrowSource"
+    # the three adjacent Projects before the filter collapse into one
+    assert len(info["fused_ops"]) < len(info["narrow_ops"])
+    assert info["narrow_ops"] == [
+        "Project", "Project", "Project", "Filter", "Project"
+    ]
+    assert [op.split("[")[0] for op in info["fused_ops"]] == [
+        "Project", "Filter", "Project"
+    ]
+    text = chain.explain()
+    assert "fused" in text
+
+    planner = session._planner
+    fused = chain.to_arrow().combine_chunks()
+    stats = planner.last_query_stats
+    # one task per partition, one stage for the whole narrow chain
+    assert len(stats["stages"]) == 1
+    assert stats["stages"][0]["tasks"] == 3
+    assert stats["fusion"] and stats["fusion"][0]["fused_ops"] < stats[
+        "fusion"
+    ][0]["narrow_ops"]
+
+    planner.fuse_projects = False
+    try:
+        unfused = chain.to_arrow().combine_chunks()
+    finally:
+        planner.fuse_projects = True
+    assert fused.schema == unfused.schema
+    assert fused.equals(unfused)
