@@ -932,7 +932,38 @@ def bench_transformer_lm():
         return {"ok": False, "error": repr(e)[:300]}
 
 
+def _headline_metrics(merged: dict) -> dict:
+    """Compact cross-process totals of the obs registry's headline counters
+    — the 'where did the work go' numbers next to the trace_path."""
+
+    def total(name):
+        value = sum(
+            snap.get(name, {}).get("value", 0.0) for snap in merged.values()
+        )
+        return round(value, 3)
+
+    return {
+        "rpc_calls": total("rpc.client.calls"),
+        "store_blocks_written": total("store.blocks_written"),
+        "store_bytes_written": total("store.bytes_written"),
+        "etl_tasks_run": total("etl.tasks_run"),
+        "etl_dispatch_batches": total("etl.dispatch_batches"),
+        "etl_task_retries": total("etl.task_retries"),
+        "actor_restarts": total("cluster.actor_restarts"),
+        "estimator_steps": total("estimator.steps"),
+        "stream_bytes_uploaded": total("estimator.stream.bytes_uploaded"),
+        "input_wait_s": total("estimator.input_wait_s"),
+    }
+
+
 def main():
+    # tracing ON for the bench by default (RAYDP_TPU_TRACE=0 opts out): the
+    # run's artifact includes a Perfetto timeline of the whole ETL→fit
+    # pipeline, and the <2% overhead budget is itself a tracked number
+    os.environ.setdefault("RAYDP_TPU_TRACE", "1")
+    from raydp_tpu.obs.tracing import reinit_for_process
+
+    reinit_for_process("driver")  # re-read the env in case obs imported early
     _maybe_force_cpu()
     n_rows = int(os.environ.get("BENCH_ROWS", 200_000))
     batch = int(os.environ.get("BENCH_BATCH", 1024))
@@ -964,9 +995,23 @@ def main():
         int(os.environ.get("BENCH_DLRM_EPOCHS", 30)),
     )
 
+    # export the whole run's trace (driver + head + executors under the
+    # propagated trace ids) and the merged metrics registries
+    trace_path = os.environ.get("BENCH_TRACE_PATH", "bench_trace.json")
+    obs_headline: dict = {}
+    try:
+        from raydp_tpu.cluster import api as _cluster_api
+
+        trace_path = _cluster_api.export_trace(trace_path)
+        obs_headline = _headline_metrics(_cluster_api.dump_metrics())
+    except Exception as e:  # pragma: no cover - telemetry must not kill bench
+        obs_headline = {"error": repr(e)[:160]}
+        trace_path = None
+
     result = {
         "metric": "nyctaxi_mlp_e2e",
         "value": round(framework_sps, 1),
+        "trace_path": trace_path,
         "unit": "samples/sec/chip",
         # END-TO-END (ETL → train) vs the pure-JAX loop — BASELINE.md's own
         # wording; the train-only ratio is reported as train_vs_pure
@@ -979,6 +1024,7 @@ def main():
             "batch": batch,
             "epochs": epochs,
             **cmp,
+            "obs_metrics": obs_headline,
             "dlrm": dlrm,
             "lm": bench_transformer_lm(),
             "parallel_steps": bench_parallel_steps(),

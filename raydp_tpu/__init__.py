@@ -34,6 +34,10 @@ _LAZY = {
     # client mode: attach a second driver to a running cluster (the
     # reference's ray://host:port analog)
     "connect_cluster": ("raydp_tpu.cluster.api", "connect_cluster"),
+    # observability plane (raydp_tpu.obs): Perfetto trace export + merged
+    # cluster metrics
+    "export_trace": ("raydp_tpu.obs", "export_trace"),
+    "dump_metrics": ("raydp_tpu.cluster.api", "dump_metrics"),
 }
 
 

@@ -11,6 +11,8 @@ from raydp_tpu.cluster.api import (
     add_node,
     available_resources,
     create_placement_group,
+    dump_metrics,
+    export_trace,
     get,
     get_actor,
     head_rpc,
@@ -46,7 +48,9 @@ __all__ = [
     "available_resources",
     "create_placement_group",
     "current_context",
+    "dump_metrics",
     "exit_actor",
+    "export_trace",
     "get",
     "get_actor",
     "head_rpc",

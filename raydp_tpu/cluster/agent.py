@@ -43,7 +43,11 @@ from raydp_tpu.cluster.common import (
     recv_frame,
     rpc,
     send_frame,
+    unwrap_traced,
 )
+from raydp_tpu.obs import log as obs_log
+from raydp_tpu.obs import span as obs_span
+from raydp_tpu.obs import use_context as obs_use_context
 
 
 class _ChildProc:
@@ -240,6 +244,10 @@ class NodeAgent:
                     if child.proc.poll() is not None:
                         dead.append((actor_id, child.incarnation))
             for actor_id, incarnation in dead:
+                obs_log.warning(
+                    "hosted actor exited", actor_id=actor_id,
+                    incarnation=incarnation,
+                )
                 try:
                     rpc(
                         self.head_addr,
@@ -268,6 +276,12 @@ class NodeAgent:
             now = time.monotonic()
             if now - last_ping >= 2.0:
                 last_ping = now
+                from raydp_tpu.obs import flush_throttled as obs_flush_throttled
+
+                # piggyback the telemetry flush on the ping cadence so agent
+                # spans/metrics reach the head without a dedicated flusher
+                # thread (metrics push with tracing off too)
+                obs_flush_throttled(2.0)
                 with self.lock:
                     for actor_id in [
                         a
@@ -299,14 +313,22 @@ class NodeAgent:
                 if not verify_token(self.request, token):
                     return
                 try:
-                    method, kwargs = recv_frame(self.request)
+                    frame = recv_frame(self.request)
                 except (ConnectionError, EOFError):
                     return
+                frame, trace_ctx = unwrap_traced(frame)
+                method, kwargs = frame
                 try:
                     fn = getattr(agent, f"handle_{method}", None)
                     if fn is None:
                         raise ClusterError(f"unknown agent method {method!r}")
-                    reply = ("ok", fn(**kwargs))
+                    if trace_ctx is not None:
+                        with obs_use_context(trace_ctx), obs_span(
+                            f"agent.{method}"
+                        ):
+                            reply = ("ok", fn(**kwargs))
+                    else:
+                        reply = ("ok", fn(**kwargs))
                 except BaseException as exc:  # noqa: BLE001
                     reply = ("err", exc)
                 try:
@@ -357,6 +379,11 @@ class NodeAgent:
 
 def main() -> None:
     head_addr, node_ip, shm_ns, local_dir, resources_json = sys.argv[1:6]
+    from raydp_tpu.obs import set_process_role
+
+    # node-qualified role: two agents on different hosts can share an OS
+    # pid, and the (role, pid) pair keys metric snapshots and trace tracks
+    set_process_role(f"agent:{node_ip}")
     # anchor the serving root: the spill-path sanitizer pins file:// block
     # reads/unlinks to THIS node's spill dir
     os.environ[SESSION_ENV] = local_dir

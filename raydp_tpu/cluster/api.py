@@ -80,10 +80,11 @@ def session_dir() -> str:
 # head methods that must NOT ride the pooled transport: rpc_pooled retries
 # once on a reset connection, and a retry after the head already processed
 # the frame would double-execute these (a second create_actor spawns and
-# orphans a second OS process; a second add_node registers a ghost node)
+# orphans a second OS process; a second add_node registers a ghost node; a
+# re-sent obs_ingest would duplicate every span of the flush in the trace)
 _NON_IDEMPOTENT_HEAD_METHODS = frozenset(
     {"create_actor", "create_placement_group", "add_node",
-     "object_put_proxy_commit"}
+     "object_put_proxy_commit", "obs_ingest"}
 )
 
 
@@ -438,7 +439,11 @@ class ActorHandle:
         except (ConnectionError, FileNotFoundError, OSError) as exc:
             raise _ConnectFailed(str(exc)) from exc
         try:
-            send_frame(sock, (method, args, kwargs, no_reply))
+            from raydp_tpu.cluster.common import traced_request
+
+            # the caller's trace context rides the frame so executor-side
+            # spans (task read/compute/emit) link under the driver's stage
+            send_frame(sock, traced_request((method, args, kwargs, no_reply)))
         except BaseException:
             sock.close()
             raise
@@ -670,3 +675,23 @@ def total_resources() -> Dict[str, Dict[str, float]]:
 
 def available_resources() -> Dict[str, Dict[str, float]]:
     return head_rpc("available_resources")
+
+
+# ---------- observability ----------
+
+
+def dump_metrics() -> Dict[str, dict]:
+    """Cluster-wide metrics: ``{"<role>:<pid>": {metric: snapshot}}`` for
+    every process that has flushed telemetry to the head, merged with this
+    process's live registry. Works (locally) without a running cluster."""
+    from raydp_tpu.obs.export import dump_metrics as _dump
+
+    return _dump()
+
+
+def export_trace(path: str) -> str:
+    """Write the cluster's collected trace as Perfetto-loadable JSON (see
+    ``raydp_tpu.obs.export_trace``)."""
+    from raydp_tpu.obs.export import export_trace as _export
+
+    return _export(path)

@@ -189,11 +189,56 @@ def shm_namespace() -> str:
     return os.environ.get(SHM_NS_ENV, "")
 
 
+# ---------------------------------------------------------------------------
+# trace-context propagation (obs layer)
+#
+# When tracing is on and the calling thread carries a span context, outgoing
+# requests are wrapped in an ``("__obs__", (trace_id, span_id), request)``
+# envelope; servers unwrap with ``unwrap_traced`` and adopt the context around
+# the handled call, so one query's spans link across driver, head, agents and
+# executors. Untraced frames are byte-identical to before.
+# ---------------------------------------------------------------------------
+
+OBS_FRAME_MARK = "__obs__"
+
+
+def traced_request(request: Tuple) -> Tuple:
+    from raydp_tpu.obs.tracing import current_context, enabled
+
+    if enabled():
+        ctx = current_context()
+        if ctx is not None:
+            return (OBS_FRAME_MARK, ctx, request)
+    return request
+
+
+def unwrap_traced(request: Any) -> Tuple[Any, Optional[Tuple[str, str]]]:
+    """(inner_request, trace_ctx_or_None) — the server half."""
+    if (
+        isinstance(request, tuple)
+        and len(request) == 3
+        and request[0] == OBS_FRAME_MARK
+    ):
+        return request[2], request[1]
+    return request, None
+
+
+def _observe_rpc(request: Tuple, seconds: float) -> None:
+    from raydp_tpu.obs.metrics import metrics
+
+    metrics.counter("rpc.client.calls").inc()
+    metrics.histogram("rpc.client.seconds").observe(seconds)
+    if isinstance(request, tuple) and request and isinstance(request[0], str):
+        metrics.counter(f"rpc.client.calls.{request[0]}").inc()
+
+
 def rpc(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) -> Any:
     """One-shot request/response. Raises the remote exception if status != ok."""
+    t0 = time.perf_counter()
     with connect(sock_path, timeout) as sock:
-        send_frame(sock, request)
+        send_frame(sock, traced_request(request))
         status, value = recv_frame(sock)
+    _observe_rpc(request, time.perf_counter() - t0)
     if status == "ok":
         return value
     raise value
@@ -235,6 +280,8 @@ def rpc_pooled(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) 
     conns = getattr(_rpc_pool_tls, "conns", None)
     if conns is None:
         conns = _rpc_pool_tls.conns = {}
+    t0 = time.monotonic()
+    wire_request = traced_request(request)
     for attempt in (0, 1):
         sock = conns.get(sock_path)
         fresh = sock is None
@@ -246,7 +293,7 @@ def rpc_pooled(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) 
                 sock = connect(sock_path, timeout)
                 conns[sock_path] = sock
             sock.settimeout(timeout)
-            send_frame(sock, request)
+            send_frame(sock, wire_request)
             status, value = recv_frame(sock)
             break
         except socket.timeout:
@@ -260,6 +307,7 @@ def rpc_pooled(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) 
             _pool_drop(sock_path)
             if attempt or fresh:
                 raise
+    _observe_rpc(request, time.monotonic() - t0)
     if status == "ok":
         return value
     raise value

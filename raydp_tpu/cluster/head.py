@@ -41,7 +41,13 @@ from raydp_tpu.cluster.common import (
     recv_frame,
     rpc,
     send_frame,
+    unwrap_traced,
 )
+from raydp_tpu.obs import instant as obs_instant
+from raydp_tpu.obs import log as obs_log
+from raydp_tpu.obs import metrics as obs_metrics
+from raydp_tpu.obs import span as obs_span
+from raydp_tpu.obs import use_context as obs_use_context
 
 _EPS = 1e-9
 
@@ -131,6 +137,18 @@ class Head:
         self.shutting_down = False
         self._next_ip = 2
         self.tcp_addr: Optional[str] = None  # set by run_head once bound
+        # observability aggregation point: every process ships its span ring
+        # buffer + metrics snapshot here (obs_ingest); export_trace /
+        # dump_metrics read them back (obs_dump). Bounded: the oldest spans
+        # drop first, with the drop counted, so a chatty run degrades to a
+        # truncated trace instead of unbounded head memory.
+        import collections as _collections
+
+        self.obs_spans: "_collections.deque" = _collections.deque(
+            maxlen=int(os.environ.get("RAYDP_TPU_TRACE_HEAD_CAP", "200000"))
+        )
+        self.obs_dropped = 0
+        self.obs_metrics: Dict[str, dict] = {}
         if default_resources:
             self._add_node(default_resources)
 
@@ -186,6 +204,11 @@ class Head:
             if node is None or not node.alive:
                 raise ClusterError(f"unknown or dead node {node_id}")
             node.alive = False
+            obs_log.warning(
+                "node removed", node_id=node_id, node_ip=node.node_ip,
+                agent=bool(node.agent_addr),
+            )
+            obs_instant("cluster.node_removed", node_id=node_id)
             self.node_available[node_id] = {}
             for actor in self.actors.values():
                 if actor.node_id == node_id and actor.state in (
@@ -676,6 +699,20 @@ class Head:
                 pass
         if actor.intentional_exit or actor.restarts_used >= actor.spec.max_restarts:
             actor.state = ActorState.DEAD
+            if not actor.intentional_exit:
+                # a crash past max_restarts is a real loss — attributable in
+                # the head log AND visible in the trace timeline
+                obs_log.error(
+                    "actor dead (restarts exhausted)",
+                    actor_id=actor.spec.actor_id, name=actor.spec.name,
+                    restarts_used=actor.restarts_used, error=actor.error,
+                )
+            obs_instant(
+                "cluster.actor_dead",
+                actor_id=actor.spec.actor_id,
+                intentional=actor.intentional_exit,
+            )
+            obs_metrics.counter("cluster.actor_deaths").inc()
             self.actor_state_cond.notify_all()
             self._on_owner_dead(actor.spec.actor_id)
             if actor.spec.name is not None:
@@ -686,6 +723,16 @@ class Head:
         actor.incarnation += 1
         actor.state = ActorState.RESTARTING
         actor.pending_respawn = True
+        obs_log.warning(
+            "actor crashed; restarting",
+            actor_id=actor.spec.actor_id, name=actor.spec.name,
+            incarnation=actor.incarnation, restarts_used=actor.restarts_used,
+        )
+        obs_instant(
+            "cluster.actor_restart",
+            actor_id=actor.spec.actor_id, incarnation=actor.incarnation,
+        )
+        obs_metrics.counter("cluster.actor_restarts").inc()
         self._try_respawn(actor)
 
     def _try_respawn(self, actor: _Actor) -> None:
@@ -916,6 +963,57 @@ class Head:
                 target=self._unlink_objects, args=(dead,), daemon=True
             ).start()
 
+    # ---------- observability (obs layer aggregation) ----------
+
+    def handle_obs_ingest(
+        self, proc: dict, spans: List[dict], metrics_snapshot: dict
+    ):
+        """A process flushed its span ring buffer + metrics registry here.
+        Metrics snapshots are cumulative per process — replace, keyed by
+        (role, pid); spans append into the bounded deque."""
+        with self.lock:
+            if spans:
+                overflow = (
+                    len(self.obs_spans) + len(spans) - (self.obs_spans.maxlen or 0)
+                )
+                if overflow > 0:
+                    self.obs_dropped += overflow
+                self.obs_spans.extend(spans)
+            if metrics_snapshot:
+                key = f"{proc.get('role', 'proc')}:{proc.get('pid', 0)}"
+                metrics_snapshot = dict(metrics_snapshot)
+                if proc.get("dropped"):
+                    metrics_snapshot["trace.spans_dropped"] = {
+                        "type": "counter", "value": proc["dropped"],
+                    }
+                self.obs_metrics[key] = metrics_snapshot
+        return True
+
+    def handle_obs_dump(self, clear: bool = False):
+        """Everything collected so far (export_trace / dump_metrics read
+        side). The head contributes its own local buffer and registry too —
+        it never RPCs itself."""
+        from raydp_tpu.obs.metrics import metrics as local_metrics
+        from raydp_tpu.obs.tracing import drain_local, process_role
+
+        own = drain_local()
+        with self.lock:
+            if own:
+                self.obs_spans.extend(own)
+            snapshot = local_metrics.snapshot()
+            if snapshot:
+                self.obs_metrics[f"{process_role()}:{os.getpid()}"] = snapshot
+            out = {
+                "spans": list(self.obs_spans),
+                "metrics": dict(self.obs_metrics),
+                "dropped": self.obs_dropped,
+            }
+            if clear:
+                self.obs_spans.clear()
+                self.obs_metrics.clear()
+                self.obs_dropped = 0
+        return out
+
     # ---------- lifecycle ----------
 
     def handle_ping(self):
@@ -1054,14 +1152,26 @@ class _Handler(socketserver.BaseRequestHandler):
         # connection for their lifetime and skip per-call connect+accept
         while True:
             try:
-                method, kwargs = recv_frame(self.request)
+                frame = recv_frame(self.request)
             except (ConnectionError, EOFError, OSError):
                 return
+            frame, trace_ctx = unwrap_traced(frame)
+            method, kwargs = frame
             try:
                 fn = getattr(head, f"handle_{method}", None)
                 if fn is None:
                     raise ClusterError(f"unknown head method {method!r}")
-                result = fn(**kwargs)
+                if trace_ctx is not None and not method.startswith("obs_"):
+                    # adopt the caller's trace: the head's handling of a
+                    # traced control-plane call becomes a child span on the
+                    # head's own track (obs ship/dump calls stay untraced —
+                    # tracing the trace plane would feed back on itself)
+                    with obs_use_context(trace_ctx), obs_span(
+                        f"head.{method}"
+                    ):
+                        result = fn(**kwargs)
+                else:
+                    result = fn(**kwargs)
                 reply = ("ok", result)
             except BaseException as exc:  # noqa: BLE001 - propagate to caller
                 exc.__cause__ = None
@@ -1106,7 +1216,12 @@ def _advertised_ip() -> str:
 
 
 def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, float]) -> None:
+    from raydp_tpu.obs.tracing import set_local_ingest, set_process_role
+
+    set_process_role("head")
     head = Head(session_dir, driver_pid, default_resources)
+    # the head's own spans/metrics ingest directly — no RPC loopback
+    set_local_ingest(head.handle_obs_ingest)
     server = _Server(head_sock_path(session_dir), _Handler)
     server.head = head  # type: ignore[attr-defined]
     # TCP beside the Unix socket: node agents (and their actors) on other

@@ -28,7 +28,10 @@ from raydp_tpu.cluster.common import (
     resolve_head_addr,
     rpc,
     send_frame,
+    unwrap_traced,
 )
+from raydp_tpu.obs import log as obs_log
+from raydp_tpu.obs import use_context as obs_use_context
 
 
 class _WorkerContext:
@@ -94,9 +97,14 @@ def _serve(
             # clients reuse the connection for sequential calls
             while True:
                 try:
-                    method, args, kwargs, no_reply = recv_frame(self.request)
+                    frame = recv_frame(self.request)
                 except (ConnectionError, EOFError, OSError):
                     return
+                # traced frames wrap the call tuple in an ("__obs__", ctx, …)
+                # envelope; the caller's (trace, span) context is adopted for
+                # the method body so its spans link into the caller's trace
+                frame, trace_ctx = unwrap_traced(frame)
+                method, args, kwargs, no_reply = frame
                 if method == "__ping__":
                     send_frame(self.request, ("ok", "pong"))
                     continue
@@ -109,10 +117,11 @@ def _serve(
                 # method/args/kwargs on the NEXT recv, and a pooled client's
                 # no_reply call must not race its successor into running
                 # with the successor's arguments
-                def run(method=method, args=args, kwargs=kwargs):
+                def run(method=method, args=args, kwargs=kwargs, ctx=trace_ctx):
                     try:
                         fn = getattr(instance, method)
-                        return ("ok", fn(*args, **kwargs))
+                        with obs_use_context(ctx):
+                            return ("ok", fn(*args, **kwargs))
                     except BaseException as exc:  # noqa: BLE001
                         tb = traceback.format_exc()
                         try:
@@ -175,6 +184,11 @@ def main() -> None:
     session_dir, actor_id, incarnation_str = sys.argv[1], sys.argv[2], sys.argv[3]
     incarnation = int(incarnation_str)
     _context = _WorkerContext(session_dir, actor_id, incarnation)
+    from raydp_tpu.obs.tracing import reinit_for_process
+
+    # re-reads RAYDP_TPU_TRACE: a zygote-forked worker inherits the ZYGOTE's
+    # tracing state, but this SESSION's env (riding the fork request) decides
+    reinit_for_process(f"worker:{actor_id}")
     head = resolve_head_addr(session_dir)
 
     spec_path = os.path.join(session_dir, f"a-{actor_id}.spec")
@@ -233,7 +247,13 @@ def main() -> None:
         try:
             instance.on_shutdown()
         except Exception:
-            traceback.print_exc()
+            obs_log.exception(
+                "on_shutdown hook failed", actor_id=actor_id,
+                incarnation=incarnation,
+            )
+    from raydp_tpu.obs import flush as obs_flush
+
+    obs_flush()  # graceful exits ship their remaining spans/metrics
 
 
 if __name__ == "__main__":

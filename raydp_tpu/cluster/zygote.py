@@ -120,9 +120,12 @@ def _become_worker(req: dict, conn: socket.socket) -> None:
     except SystemExit:
         pass
     except BaseException:  # noqa: BLE001 - last-resort report to the log
-        import traceback
+        from raydp_tpu.obs import get_logger
 
-        traceback.print_exc()
+        get_logger("zygote-child").exception(
+            "forked worker died before handing off to worker.main",
+            actor_id=req.get("actor_id"), run_dir=req.get("run_dir"),
+        )
         os._exit(1)
     finally:
         os._exit(0)
@@ -151,9 +154,9 @@ def _serve_one(children: dict) -> bool:
         children[pid] = req["log_base"]
         send_frame(conn, ("ok", pid))
     except Exception:  # noqa: BLE001 - a bad request must not kill the zygote
-        import traceback
+        from raydp_tpu.obs import get_logger
 
-        traceback.print_exc()
+        get_logger("zygote").exception("fork request failed")
     finally:
         try:
             conn.close()
@@ -171,6 +174,9 @@ GLOBAL_IDLE_TTL_S = 1800.0
 def main() -> None:
     global _listener
     run_dir = sys.argv[1]
+    from raydp_tpu.obs import set_process_role
+
+    set_process_role("zygote")
     # global mode (common.start_zygote): this zygote serves EVERY cluster of
     # this user+source-tree on the machine — fork requests carry the target
     # session's run_dir/env, so nothing here is session-specific. It ignores

@@ -116,6 +116,15 @@ def _put_stacked_batch(mesh, arr):
     return _fmap(lambda a: device_put_stacked(a, mesh), arr)
 
 
+def _compile_span(what):
+    """The one timer for AOT compile sites: the span's duration feeds
+    ``compile_seconds_`` and (when tracing ships) the trace timeline — no
+    parallel perf_counter bookkeeping."""
+    from raydp_tpu import obs
+
+    return obs.span("estimator.compile", what=str(what))
+
+
 def _scan_over_batches(step_impl, params, opt_state, xb, yb):
     """Run the train step over stacked batches [S, B, ...] with ONE
     ``lax.scan`` — the shared core of the whole-epoch and segment-stream
@@ -483,10 +492,23 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
         baseline = latest_checkpoint(self.checkpoint_dir) if retry_resume else None
         saved_resume = self.resume_from_epoch
+        from raydp_tpu import obs
+
         try:
             while True:
                 try:
-                    return self._fit_once(train_ds, evaluate_ds)
+                    # the collector forces REAL spans on this thread even
+                    # with trace shipping off: epoch/compile wall times in
+                    # history and compile_seconds_ are read from the same
+                    # span records the trace timeline shows — the obs layer
+                    # is the single timing source, not a parallel one
+                    with obs.collect(), obs.span(
+                        "estimator.fit",
+                        epochs=self.num_epochs,
+                        streaming=str(self.streaming),
+                        attempt=attempts,
+                    ):
+                        return self._fit_once(train_ds, evaluate_ds)
                 except Exception:
                     attempts += 1
                     if attempts > max_retries:
@@ -569,17 +591,19 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             )
             sample_np = _fmap(lambda a: a[:batch_size], train_source.features)
 
+        from raydp_tpu import obs
+
         enable_persistent_compilation_cache()
-        compile_start = time.perf_counter()
         rng = jax.random.PRNGKey(self.seed)
-        # one jitted init: flax init run eagerly compiles dozens of tiny ops,
-        # which costs ~0.5s EACH on cold TPU backends (measured ~30s total)
-        sample = _fmap(jnp.asarray, sample_np)
-        params, opt_state = jax.jit(
-            lambda r, s: (lambda p: (p, tx.init(p)))(module.init(r, s))
-        )(rng, sample)
-        jax.block_until_ready(params)
-        init_compile = time.perf_counter() - compile_start
+        with obs.span("estimator.compile", what="init") as init_span:
+            # one jitted init: flax init run eagerly compiles dozens of tiny
+            # ops, which costs ~0.5s EACH on cold TPU backends (~30s total)
+            sample = _fmap(jnp.asarray, sample_np)
+            params, opt_state = jax.jit(
+                lambda r, s: (lambda p: (p, tx.init(p)))(module.init(r, s))
+            )(rng, sample)
+            jax.block_until_ready(params)
+        init_compile = init_span.duration
         from raydp_tpu.exchange.jax_io import _mesh_device_count, _mesh_single_device
 
         if self.param_sharding_rules is not None:
@@ -642,13 +666,32 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             restored = self._restore_checkpoint(
                 resume_epoch, template, step=resume_step
             )
-            params = jax.device_put(
-                restored["params"], jax.tree.map(lambda p: p.sharding, params)
+            # Stage restored leaves as JAX-OWNED buffers before any
+            # dispatch: on CPU, device_put/jnp.asarray zero-copy suitably-
+            # aligned numpy arrays, so the staged state would alias host
+            # memory owned by orbax's restore machinery — and with
+            # donate_state the first train step hands exactly those aliased
+            # buffers to XLA for reuse. Observed on 2-core CPU boxes as
+            # garbage/denormal params after a mid-epoch resume (the seed-era
+            # "streaming NaN" flake); a host-side numpy copy does NOT fix it
+            # (the copy is zero-copy-staged and donated all the same). The
+            # on-device ``jnp.array(…, copy=True)`` allocates a fresh
+            # runtime-owned buffer in the TARGET sharding — donation-safe,
+            # dtype-preserving, and large sharded models never materialize
+            # an unsharded leaf on one device (device_put shards during
+            # transfer).
+            def _owned(x, like_sharding):
+                return jnp.array(jax.device_put(x, like_sharding), copy=True)
+
+            params = jax.tree.map(
+                lambda x, p: _owned(x, p.sharding), restored["params"], params
             )
             # exact resume incl. optimizer moments; leave uncommitted — jit
             # places leaves to match params (the live opt_state's scalar
             # leaves are uncommitted too)
-            opt_state = jax.tree.map(jnp.asarray, restored["opt_state"])
+            opt_state = jax.tree.map(
+                lambda x: jnp.array(x, copy=True), restored["opt_state"]
+            )
             if resume_step is None:
                 start_epoch = resume_epoch + 1
             else:
@@ -726,101 +769,113 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             for epoch in (
                 () if fullfit_done else range(start_epoch, self.num_epochs)
             ):
-                epoch_start = time.perf_counter()
                 epoch_seed = None if not self.shuffle else self.seed + epoch
                 epoch_start_step = start_step if epoch == start_epoch else 0
-                if run_scan_epoch is not None:
-                    params, opt_state, loss_sum, steps = run_scan_epoch(
-                        params, opt_state, epoch_seed,
-                        start_step=epoch_start_step,
-                        save_cb=(
-                            (lambda p, o, s, _e=epoch: save_mid_epoch(p, o, _e, s))
-                            if save_steps
-                            else None
-                        ),
-                    )
-                elif run_stream_segments is not None:
-                    # coalesced fast path: pull whole segments as one
-                    # contiguous slice each (checkpoint resumes land on
-                    # segment boundaries by construction — seg divides
-                    # save_every_steps; anything else falls back to the
-                    # batch-granular producer)
-                    seg_steps = self._stream_segment_steps
-                    coalesced = epoch_start_step % seg_steps == 0
-                    host_iter = self._epoch_batches(
-                        train_source, batch_size, epoch_seed,
-                        segment_rows=(
-                            seg_steps * batch_size if coalesced else None
-                        ),
-                    )
-                    if epoch_start_step:
-                        import itertools
-
-                        skip = (
-                            epoch_start_step // seg_steps
-                            if coalesced
-                            else epoch_start_step
+                # the epoch span IS the epoch timer: history's epoch_seconds
+                # is read from the same record the trace timeline shows
+                with obs.span(
+                    "estimator.epoch", epoch=epoch,
+                    resumed_at=epoch_start_step,
+                ) as epoch_span:
+                    if run_scan_epoch is not None:
+                        params, opt_state, loss_sum, steps = run_scan_epoch(
+                            params, opt_state, epoch_seed,
+                            start_step=epoch_start_step,
+                            save_cb=(
+                                (lambda p, o, s, _e=epoch: save_mid_epoch(p, o, _e, s))
+                                if save_steps
+                                else None
+                            ),
                         )
-                        host_iter = itertools.islice(host_iter, skip, None)
-                    params, opt_state, loss_sum, steps = run_stream_segments(
-                        params, opt_state, host_iter, epoch_start_step,
-                        save_cb=(
-                            (lambda p, o, s, _e=epoch: save_mid_epoch(p, o, _e, s))
-                            if save_steps
-                            else None
-                        ),
-                        epoch=epoch,
-                        coalesced=coalesced,
-                    )
-                else:
-                    host_iter = self._epoch_batches(
-                        train_source, batch_size, epoch_seed
-                    )
-                    if epoch_start_step:
-                        # deterministic order per (seed, epoch): dropping the
-                        # first K batches replays exactly the un-run tail
-                        import itertools
-
-                        host_iter = itertools.islice(
-                            host_iter, epoch_start_step, None
+                    elif run_stream_segments is not None:
+                        # coalesced fast path: pull whole segments as one
+                        # contiguous slice each (checkpoint resumes land on
+                        # segment boundaries by construction — seg divides
+                        # save_every_steps; anything else falls back to the
+                        # batch-granular producer)
+                        seg_steps = self._stream_segment_steps
+                        coalesced = epoch_start_step % seg_steps == 0
+                        host_iter = self._epoch_batches(
+                            train_source, batch_size, epoch_seed,
+                            segment_rows=(
+                                seg_steps * batch_size if coalesced else None
+                            ),
                         )
-                    train_iter = PrefetchingDeviceIterator(host_iter, mesh)
-                    loss_sum = jnp.zeros((), jnp.float32)
-                    steps = epoch_start_step
-                    pending_save = None
-                    for x, y in train_iter:
-                        if pending_save is not None:
-                            # DEFERRED one step: a save that would coincide
-                            # with the epoch's final step is dropped (the
-                            # epoch-complete epoch_N supersedes it) — so a
-                            # step checkpoint always has tail steps to replay
-                            save_mid_epoch(params, opt_state, epoch, pending_save)
-                            pending_save = None
-                        if not first_step_done:
-                            # the first call compiles (cold TPU compiles take
-                            # tens of seconds); record it so callers can
-                            # report steady-state throughput separately
-                            t0 = time.perf_counter()
-                            params, opt_state, loss_sum = train_step(
-                                params, opt_state, loss_sum, x, y
+                        if epoch_start_step:
+                            import itertools
+
+                            skip = (
+                                epoch_start_step // seg_steps
+                                if coalesced
+                                else epoch_start_step
                             )
-                            jax.block_until_ready(loss_sum)
-                            self.compile_seconds_ += time.perf_counter() - t0
-                            first_step_done = True
-                        else:
-                            params, opt_state, loss_sum = train_step(
-                                params, opt_state, loss_sum, x, y
+                            host_iter = itertools.islice(host_iter, skip, None)
+                        params, opt_state, loss_sum, steps = run_stream_segments(
+                            params, opt_state, host_iter, epoch_start_step,
+                            save_cb=(
+                                (lambda p, o, s, _e=epoch: save_mid_epoch(p, o, _e, s))
+                                if save_steps
+                                else None
+                            ),
+                            epoch=epoch,
+                            coalesced=coalesced,
+                        )
+                    else:
+                        host_iter = self._epoch_batches(
+                            train_source, batch_size, epoch_seed
+                        )
+                        if epoch_start_step:
+                            # deterministic order per (seed, epoch): dropping
+                            # the first K batches replays exactly the un-run
+                            # tail
+                            import itertools
+
+                            host_iter = itertools.islice(
+                                host_iter, epoch_start_step, None
                             )
-                        steps += 1
-                        if save_steps and steps % save_steps == 0:
-                            pending_save = steps
-                        if (
-                            self.sync_every_steps
-                            and steps % self.sync_every_steps == 0
-                        ):
-                            # bounded pipeline bubble; see __init__ comment
-                            jax.block_until_ready(loss_sum)
-                    steps -= epoch_start_step
+                        train_iter = PrefetchingDeviceIterator(host_iter, mesh)
+                        loss_sum = jnp.zeros((), jnp.float32)
+                        steps = epoch_start_step
+                        pending_save = None
+                        for x, y in train_iter:
+                            if pending_save is not None:
+                                # DEFERRED one step: a save that would
+                                # coincide with the epoch's final step is
+                                # dropped (the epoch-complete epoch_N
+                                # supersedes it) — so a step checkpoint
+                                # always has tail steps to replay
+                                save_mid_epoch(params, opt_state, epoch, pending_save)
+                                pending_save = None
+                            if not first_step_done:
+                                # the first call compiles (cold TPU compiles
+                                # take tens of seconds); record it so callers
+                                # can report steady-state throughput
+                                # separately
+                                with obs.span(
+                                    "estimator.compile", what="first_step"
+                                ) as cspan:
+                                    params, opt_state, loss_sum = train_step(
+                                        params, opt_state, loss_sum, x, y
+                                    )
+                                    jax.block_until_ready(loss_sum)
+                                self.compile_seconds_ += cspan.duration
+                                first_step_done = True
+                            else:
+                                params, opt_state, loss_sum = train_step(
+                                    params, opt_state, loss_sum, x, y
+                                )
+                            steps += 1
+                            if save_steps and steps % save_steps == 0:
+                                pending_save = steps
+                            if (
+                                self.sync_every_steps
+                                and steps % self.sync_every_steps == 0
+                            ):
+                                # bounded pipeline bubble; see __init__
+                                jax.block_until_ready(loss_sum)
+                        steps -= epoch_start_step
+                    epoch_span.set(steps=steps)
+                obs.metrics.counter("estimator.steps").inc(steps)
                 if steps == 0 and epoch_start_step > 0:
                     # resumed exactly at this epoch's end (a stale final-step
                     # checkpoint from an older layout): nothing trained —
@@ -835,12 +890,15 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 record: Dict[str, Any] = {
                     "epoch": epoch,
                     "train_loss": (loss_sum, steps),
-                    "epoch_seconds": time.perf_counter() - epoch_start,
+                    "epoch_seconds": epoch_span.duration,
                 }
                 if eval_source is not None:
-                    record.update(
-                        self._evaluate_host(eval_source, params, eval_fns, mesh, batch_size)
-                    )
+                    with obs.span("estimator.eval", epoch=epoch):
+                        record.update(
+                            self._evaluate_host(
+                                eval_source, params, eval_fns, mesh, batch_size
+                            )
+                        )
                 self._history.append(record)
                 # EVERY process calls save: orbax's Checkpointer runs
                 # cross-process barriers and writes from the primary host
@@ -878,6 +936,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         # path every fit; apply/evaluate are faster with device params, and
         # checkpointing does its own device_get
         self._params = params
+        obs.metrics.counter("estimator.fits").inc()
+        obs.metrics.gauge("estimator.compile_s").set(self.compile_seconds_)
         return self._history
 
     # per-fit streaming pipeline stats (VERDICT r4 weak #4: the streaming
@@ -955,20 +1015,33 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             (reshaped zero-copy); otherwise per-batch items are stacked."""
 
             def _emit(item) -> bool:
+                from raydp_tpu import obs
+
                 t0 = time.perf_counter()
                 while not stop.is_set():
                     try:
                         out_q.put(item, timeout=0.2)
                         # time parked on a FULL queue = consumer-bound
-                        stats["producer_idle_s"] += time.perf_counter() - t0
+                        idle = time.perf_counter() - t0
+                        stats["producer_idle_s"] += idle
+                        obs.metrics.counter(
+                            "estimator.stream.producer_idle_s"
+                        ).inc(idle)
                         return True
                     except queue.Full:
                         continue
                 return False
 
             def _upload(hx, hy):
-                stats["bytes_uploaded"] += _f_nbytes(hx) + hy.nbytes
+                from raydp_tpu import obs
+
+                nbytes = _f_nbytes(hx) + hy.nbytes
+                stats["bytes_uploaded"] += nbytes
                 stats["segments"] += 1
+                obs.metrics.counter("estimator.stream.bytes_uploaded").inc(
+                    nbytes
+                )
+                obs.metrics.counter("estimator.stream.segments").inc()
                 return (
                     _put_stacked_batch(mesh, hx),
                     _put_stacked_batch(mesh, hy),
@@ -1083,11 +1156,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 xb, yb = cache[int(oi)]
                 length = _f0(xb).shape[0]
                 if length not in compiled:
-                    t0 = time.perf_counter()
-                    compiled[length] = jitted.lower(
-                        params, opt_state, xb, yb
-                    ).compile()
-                    self.compile_seconds_ += time.perf_counter() - t0
+                    with _compile_span(length) as cspan:
+                        compiled[length] = jitted.lower(
+                            params, opt_state, xb, yb
+                        ).compile()
+                    self.compile_seconds_ += cspan.duration
                 params, opt_state, loss_sum = compiled[length](
                     params, opt_state, xb, yb
                 )
@@ -1112,11 +1185,17 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             pending_save = None
             dispatches = 0
             cache_bytes = 0
+            from raydp_tpu import obs
+
             while True:
                 t0 = time.perf_counter()
                 item = seg_q.get()
                 # time parked on an EMPTY queue = transfer/producer-bound
-                stats["consumer_idle_s"] += time.perf_counter() - t0
+                idle = time.perf_counter() - t0
+                stats["consumer_idle_s"] += idle
+                obs.metrics.counter("estimator.stream.consumer_idle_s").inc(
+                    idle
+                )
                 if item is None:
                     break
                 if isinstance(item, BaseException):
@@ -1137,11 +1216,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     pending_save = None
                 length = _f0(xb).shape[0]
                 if length not in compiled:
-                    t0 = time.perf_counter()
-                    compiled[length] = jitted.lower(
-                        params, opt_state, xb, yb
-                    ).compile()
-                    self.compile_seconds_ += time.perf_counter() - t0
+                    with _compile_span(length) as cspan:
+                        compiled[length] = jitted.lower(
+                            params, opt_state, xb, yb
+                        ).compile()
+                    self.compile_seconds_ += cspan.duration
                 params, opt_state, loss_sum = compiled[length](
                     params, opt_state, xb, yb
                 )
@@ -1275,13 +1354,13 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     order[start * batch_size : (start + length) * batch_size]
                 )
                 if length not in compiled:
-                    t0 = time.perf_counter()
-                    compiled[length] = (
-                        make_gather(length)
-                        .lower(params, opt_state, xs_dev, ys_dev, perm)
-                        .compile()
-                    )
-                    self.compile_seconds_ += time.perf_counter() - t0
+                    with _compile_span(length) as cspan:
+                        compiled[length] = (
+                            make_gather(length)
+                            .lower(params, opt_state, xs_dev, ys_dev, perm)
+                            .compile()
+                        )
+                    self.compile_seconds_ += cspan.duration
                 return compiled[length](params, opt_state, xs_dev, ys_dev, perm)
 
         else:
@@ -1302,11 +1381,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     mesh, labs[sel].reshape((length, batch_size) + labs.shape[1:])
                 )
                 if length not in compiled:
-                    t0 = time.perf_counter()
-                    compiled[length] = jitted.lower(
-                        params, opt_state, xb, yb
-                    ).compile()
-                    self.compile_seconds_ += time.perf_counter() - t0
+                    with _compile_span(length) as cspan:
+                        compiled[length] = jitted.lower(
+                            params, opt_state, xb, yb
+                        ).compile()
+                    self.compile_seconds_ += cspan.duration
                 return compiled[length](params, opt_state, xb, yb)
 
         def run_epoch(params, opt_state, seed, start_step=0, save_cb=None):
@@ -1371,16 +1450,16 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 perms = jnp.asarray(np.stack([_order(s) for s in seeds]))
                 key = ("fullfit", len(seeds))
                 if key not in compiled:
-                    t0 = time.perf_counter()
-                    compiled[key] = (
-                        jax.jit(
-                            fullfit_body,
-                            donate_argnums=(0, 1) if donate else (),
+                    with _compile_span("fullfit") as cspan:
+                        compiled[key] = (
+                            jax.jit(
+                                fullfit_body,
+                                donate_argnums=(0, 1) if donate else (),
+                            )
+                            .lower(params, opt_state, xs_dev, ys_dev, perms)
+                            .compile()
                         )
-                        .lower(params, opt_state, xs_dev, ys_dev, perms)
-                        .compile()
-                    )
-                    self.compile_seconds_ += time.perf_counter() - t0
+                    self.compile_seconds_ += cspan.duration
                 params, opt_state, losses = compiled[key](
                     params, opt_state, xs_dev, ys_dev, perms
                 )
@@ -1631,14 +1710,27 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         step-level resume, strictly stronger than the reference's model-only
         AIR checkpoints (torch/estimator.py:243-250). ``step`` is the number
         of completed steps WITHIN ``epoch`` (save_every_steps cadence);
-        ``step=None`` marks the epoch complete."""
+        ``step=None`` marks the epoch complete.
+
+        The host state is DEEP-COPIED before it reaches orbax: on backends
+        where ``device_get`` is zero-copy (CPU), the returned numpy arrays
+        alias the live device buffers, and orbax's StandardCheckpointer can
+        complete file writes asynchronously — with ``donate_state`` a later
+        train step reuses those exact buffers, so an in-flight write could
+        serialize whatever the optimizer scribbled over them. (Same aliased-
+        buffer-vs-donation hazard class as the resume-staging fix in
+        ``_fit_once``, which was the verified root cause of the 2-core-box
+        "streaming NaN" flake; the copy here closes the save-side window.)"""
         import jax
         import orbax.checkpoint as ocp
 
-        state = {
-            "params": jax.device_get(params),
-            "opt_state": jax.device_get(opt_state),
-        }
+        state = jax.tree.map(
+            lambda x: np.array(x, copy=True),
+            {
+                "params": jax.device_get(params),
+                "opt_state": jax.device_get(opt_state),
+            },
+        )
         with ocp.StandardCheckpointer() as ckptr:
             ckptr.save(self._ckpt_path(epoch, step), state, force=True)
 

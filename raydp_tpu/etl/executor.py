@@ -79,12 +79,37 @@ class EtlExecutor:
     def ping(self) -> int:
         return self.executor_id
 
-    def run_task(self, spec: T.TaskSpec) -> T.TaskResult:
-        import time
+    def _run_one(self, spec: T.TaskSpec) -> T.TaskResult:
+        from raydp_tpu import obs
 
-        t0 = time.perf_counter()
-        result = T.run_task(spec)
-        result.server_seconds = time.perf_counter() - t0
+        # the executor.task span both feeds server_seconds (query stats) and
+        # lands on this executor's trace track, parented under the driver's
+        # stage span (the context rode in on the RPC frame)
+        with obs.collect():
+            with obs.span(
+                "executor.task", executor=self.executor_id,
+                partition=spec.partition_index,
+            ) as s:
+                result = T.run_task(spec)
+        result.server_seconds = s.duration
+        return result
+
+    @staticmethod
+    def _ship_telemetry() -> None:
+        """End-of-dispatch ship point. Unthrottled when tracing is on:
+        executors die by SIGKILL at session stop, so a throttled-away tail
+        flush would lose the final dispatch's spans for good. Metrics-only
+        pushes (tracing off) stay throttled."""
+        from raydp_tpu import obs
+
+        if obs.enabled():
+            obs.flush()
+        else:
+            obs.flush_throttled()
+
+    def run_task(self, spec: T.TaskSpec) -> T.TaskResult:
+        result = self._run_one(spec)
+        self._ship_telemetry()
         return result
 
     def run_tasks(self, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
@@ -92,9 +117,21 @@ class EtlExecutor:
         executor arrives in a single RPC and fans out over ``cores``
         threads here (arrow kernels release the GIL), replacing one actor
         round trip per task."""
+        from raydp_tpu import obs
+
         if len(specs) <= 1 or self.cores <= 1:
-            return [self.run_task(s) for s in specs]
-        return list(self._pool().map(self.run_task, specs))
+            results = [self._run_one(s) for s in specs]
+        else:
+            # trace context is thread-local: hand the dispatch RPC's context
+            # to the pool threads so their task spans link under the stage
+            ctx = obs.current_context()
+            results = list(
+                self._pool().map(
+                    lambda s: obs.with_context(ctx, self._run_one, s), specs
+                )
+            )
+        self._ship_telemetry()
+        return results
 
     # -- data plane (exchange layer reads, SURVEY.md §3.6 analog) --
 

@@ -190,10 +190,15 @@ class Planner:
         retried on another executor (Spark task-retry parity — executor actors
         restart, so transient deaths must not fail the query). Only connection
         breakage retries: timeouts and remote application errors propagate
-        (a slow task re-executed elsewhere would duplicate side effects)."""
-        import time
+        (a slow task re-executed elsewhere would duplicate side effects).
 
-        stage_start = time.perf_counter()
+        The whole stage runs inside an ``obs.span("etl.stage")`` — the SAME
+        record that lands on the trace timeline is what ``last_query_stats``
+        aggregates (via ``_instrumented``'s collector), and its context
+        propagates through the dispatch RPCs so executor-side task spans
+        link under it."""
+        from raydp_tpu import obs
+
         prefs: List[Optional[int]] = []
         hook = self.scale_hook
         if hook is not None:
@@ -204,6 +209,8 @@ class Planner:
             except Exception:
                 pass  # allocation policy failures must never fail the query
         batched = False
+        stage_span = obs.span("etl.stage", tasks=len(specs))
+        stage_span.__enter__()
         try:
             if not self.executors:
                 results = [T.run_task(s) for s in specs]
@@ -226,34 +233,30 @@ class Planner:
             if hook is not None:
                 with self._inflight_lock:
                     self._inflight -= 1
-            log = getattr(self._tls, "stage_log", None)
-            if log is not None:
-                entry = {
-                    "tasks": len(specs),
-                    "seconds": time.perf_counter() - stage_start,
-                    "locality_preferred": sum(
-                        1 for p in prefs if p is not None
-                    ),
-                    "dispatch": "batched" if batched else "per_task",
-                }
-                try:
-                    # executor-side wall time per task: lets query stats
-                    # split compute from dispatch/transport overhead
-                    entry["server_seconds"] = round(
+            stage_span.set(
+                locality_preferred=sum(1 for p in prefs if p is not None),
+                dispatch="batched" if batched else "per_task",
+            )
+            obs.metrics.counter("etl.stages").inc()
+            obs.metrics.counter("etl.tasks_dispatched").inc(len(specs))
+            if batched:
+                obs.metrics.counter("etl.dispatch_batches").inc()
+            try:
+                # executor-side wall time per task: lets query stats
+                # split compute from dispatch/transport overhead
+                stage_span.set(
+                    server_seconds=round(
                         sum(r.server_seconds for r in results), 6
-                    )
-                    entry["read_s"] = round(
-                        sum(r.read_seconds for r in results), 6
-                    )
-                    entry["compute_s"] = round(
+                    ),
+                    read_s=round(sum(r.read_seconds for r in results), 6),
+                    compute_s=round(
                         sum(r.compute_seconds for r in results), 6
-                    )
-                    entry["emit_s"] = round(
-                        sum(r.emit_seconds for r in results), 6
-                    )
-                except (NameError, AttributeError):
-                    pass  # dispatch raised before results existed
-                log.append(entry)
+                    ),
+                    emit_s=round(sum(r.emit_seconds for r in results), 6),
+                )
+            except (NameError, AttributeError):
+                pass  # dispatch raised before results existed
+            stage_span.__exit__(None, None, None)
 
     def _submit_batched(
         self, specs: List[T.TaskSpec], prefs: List[Optional[int]]
@@ -302,6 +305,12 @@ class Planner:
                 for i, r in zip(group, batch):
                     results[i] = r
             except (ConnectionError, EOFError, _ActorDied):
+                from raydp_tpu import obs
+
+                obs.instant(
+                    "etl.batch_retry", tasks=len(group), attempt=1
+                )
+                obs.metrics.counter("etl.task_retries").inc(len(group))
                 fallback.extend(group)
         if fallback:
             # per-task retry ladder over a DENSE spec list (_gather indexes
@@ -317,6 +326,8 @@ class Planner:
         return results  # type: ignore[return-value]
 
     def _gather(self, futures, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
+        from raydp_tpu import obs
+
         results: List[Optional[T.TaskResult]] = [None] * len(specs)
         for attempt in range(self.MAX_TASK_RETRIES + 1):
             retry: List[Tuple[Any, T.TaskSpec, int]] = []
@@ -326,6 +337,10 @@ class Planner:
                 except (ConnectionError, EOFError, _ActorDied):
                     if attempt == self.MAX_TASK_RETRIES:
                         raise
+                    obs.instant(
+                        "etl.task_retry", task=i, attempt=attempt + 1
+                    )
+                    obs.metrics.counter("etl.task_retries").inc()
                     retry.append((self._dispatch(spec, i, attempt + 1), spec, i))
             if not retry:
                 break
@@ -506,16 +521,17 @@ class Planner:
         return fused
 
     def _prepare_chain(self, chain: List[lp.PlanNode]) -> List[lp.PlanNode]:
-        """Strip + fuse the narrow chain for shipping; records each fusion
-        decision for last_query_stats."""
+        """Strip + fuse the narrow chain for shipping; each fusion decision
+        becomes an ``etl.fusion`` instant — visible on the trace timeline
+        AND collected into last_query_stats by ``_instrumented``."""
+        from raydp_tpu import obs
+
         shipped = self._strip_children(chain)
         fused = self._fuse_chain(shipped)
         if len(fused) != len(shipped):
-            flog = getattr(self._tls, "fusion_log", None)
-            if flog is not None:
-                flog.append(
-                    {"narrow_ops": len(shipped), "fused_ops": len(fused)}
-                )
+            obs.instant(
+                "etl.fusion", narrow_ops=len(shipped), fused_ops=len(fused)
+            )
         return fused
 
     # ------------------------------------------------------------------
@@ -603,24 +619,46 @@ class Planner:
         """Run the plan with a custom terminal output (count/inline/parquet)."""
         return self._instrumented(lambda: self._execute(node, output))
 
-    def _instrumented(self, run):
-        import time
+    # span attrs copied into each last_query_stats stage entry, in schema
+    # order (the schema test pins these keys)
+    _STAGE_ATTRS = (
+        "locality_preferred", "dispatch", "server_seconds",
+        "read_s", "compute_s", "emit_s",
+    )
 
-        if getattr(self._tls, "stage_log", None) is not None:
+    def _instrumented(self, run):
+        """Run a query action under an ``etl.query`` span with a collector
+        installed; ``last_query_stats`` is DERIVED from the collected span
+        records (stage spans, fusion/retry instants) — the trace timeline
+        and the stats API can never disagree because they are one record."""
+        from raydp_tpu import obs
+
+        if getattr(self._tls, "query_active", False):
             return run()  # nested (e.g. sort materializing its child):
             # stages contribute to the enclosing query's stats
-        start = time.perf_counter()
-        self._tls.stage_log = []
-        self._tls.fusion_log = []
+        self._tls.query_active = True
         try:
-            results = run()
+            with obs.collect() as records, obs.span("etl.query") as query_span:
+                results = run()
         finally:
-            stages = self._tls.stage_log
-            fusion = self._tls.fusion_log
-            self._tls.stage_log = None
-            self._tls.fusion_log = None
+            self._tls.query_active = False
+        stages = []
+        fusion = []
+        for record in records:
+            if record["name"] == "etl.stage":
+                args = record["args"]
+                entry = {
+                    "tasks": args.get("tasks", 0),
+                    "seconds": record["dur"] / 1e6,
+                }
+                for key in self._STAGE_ATTRS:
+                    if key in args:
+                        entry[key] = args[key]
+                stages.append(entry)
+            elif record["name"] == "etl.fusion":
+                fusion.append(dict(record["args"]))
         self.last_query_stats = {
-            "seconds": time.perf_counter() - start,
+            "seconds": query_span.duration,
             "output_partitions": len(results),
             "stages": stages,
             "fusion": fusion,

@@ -180,9 +180,17 @@ class EtlSession:
                         raise
                     time.sleep(0.2)
             self.executors.append(handle)
-        for handle in self.executors:
-            handle.wait_ready()
-        self.master.wait_ready()
+        from raydp_tpu import obs
+
+        with obs.span(
+            "etl.session_boot", app=app_name, executors=num_executors
+        ):
+            # the readiness barrier: the span shows how much of session
+            # startup waits on actor spawn/warm-up on the trace timeline
+            for handle in self.executors:
+                handle.wait_ready()
+            self.master.wait_ready()
+        obs.metrics.counter("etl.sessions_started").inc()
         self._next_executor_id = num_executors
 
         self._planner = Planner(
@@ -281,8 +289,18 @@ class EtlSession:
     @property
     def last_query_stats(self) -> dict:
         """Wall time, output partitions, and per-stage task counts/timings of
-        the most recent action (first-class step timing, SURVEY §5)."""
+        the most recent action (first-class step timing, SURVEY §5). Derived
+        from the obs layer's span records — the same ones ``export_trace``
+        puts on the timeline."""
         return self._planner.last_query_stats
+
+    def dump_metrics(self) -> dict:
+        """Cluster-wide metrics snapshot (see ``cluster.dump_metrics``)."""
+        return cluster.dump_metrics()
+
+    def export_trace(self, path: str) -> str:
+        """Write the cluster's collected trace as Perfetto JSON."""
+        return cluster.export_trace(path)
 
     # ------------------------------------------------------------------
     # dynamic allocation (reference doRequestTotalExecutors/doKillExecutors,

@@ -756,19 +756,32 @@ def _read_and_merge(spec: TaskSpec) -> pa.Table:
 def run_task(spec: TaskSpec) -> TaskResult:
     if os.environ.get("RAYDP_TPU_TASK_TRACE"):
         return _run_task_traced(spec)
-    import time
+    from raydp_tpu import obs
 
-    t0 = time.perf_counter()
-    table = _read_and_merge(spec)
-    t1 = time.perf_counter()
-    for node in spec.chain:
-        table = apply_narrow(table, node, spec.partition_index)
-    t2 = time.perf_counter()
-    result = _emit(table, spec)
-    t3 = time.perf_counter()
-    result.read_seconds = t1 - t0
-    result.compute_seconds = t2 - t1
-    result.emit_seconds = t3 - t2
+    # The spans ARE the timers: the same records that ship to the trace
+    # timeline (executor tracks in Perfetto) also fill the TaskResult phase
+    # fields last_query_stats aggregates — one instrumentation plane, no
+    # parallel hand-rolled perf_counter bookkeeping. The collect() scope
+    # forces real spans even with tracing disabled, so query stats always
+    # work; with tracing on they additionally buffer for the head.
+    with obs.collect():
+        with obs.span(
+            "task.run",
+            partition=spec.partition_index,
+            merge=spec.merge.kind,
+            output=spec.output.kind,
+        ):
+            with obs.span("task.read", inputs=len(spec.reads)) as s_read:
+                table = _read_and_merge(spec)
+            with obs.span("task.compute", ops=len(spec.chain)) as s_compute:
+                for node in spec.chain:
+                    table = apply_narrow(table, node, spec.partition_index)
+            with obs.span("task.emit", rows=table.num_rows) as s_emit:
+                result = _emit(table, spec)
+    obs.metrics.counter("etl.tasks_run").inc()
+    result.read_seconds = s_read.duration
+    result.compute_seconds = s_compute.duration
+    result.emit_seconds = s_emit.duration
     return result
 
 

@@ -15,6 +15,7 @@ here the device copy itself is async, so a depth-1 pipeline suffices).
 
 from __future__ import annotations
 
+from time import perf_counter as _perf_counter
 from typing import Iterator, Optional, Sequence, Tuple
 
 import numpy as np
@@ -118,12 +119,16 @@ class PrefetchingDeviceIterator:
                  depth: int = 1):
         from collections import deque
 
+        from raydp_tpu.obs import metrics
+
         self._host_iter = iter(host_iter)
         self._mesh = mesh
         self._axis = axis
         self._depth = max(1, int(depth))
         self._pending = deque()
         self._exhausted = False
+        # resolved ONCE: __next__ is the per-step hot path
+        self._input_wait = metrics.counter("estimator.input_wait_s")
         self._fill()
 
     def _fill(self):
@@ -144,7 +149,12 @@ class PrefetchingDeviceIterator:
         if not self._pending:
             raise StopIteration
         current = self._pending.popleft()
+        # the refill is the train loop's input wait: host slice + async H2D
+        # dispatch of the NEXT batch(es) — aggregated so "is the input
+        # pipeline the bottleneck" is answerable from dump_metrics()
+        t0 = _perf_counter()
         self._fill()
+        self._input_wait.inc(_perf_counter() - t0)
         return current
 
 

@@ -1,0 +1,73 @@
+"""Cluster-wide observability: tracing, metrics, structured logging, export.
+
+One instrumentation plane for the whole runtime (SURVEY §5: the reference
+defers everything to the Ray/Spark dashboards; we own the runtime, so we own
+the telemetry). Three pieces:
+
+- **Tracing** (`obs.span` / `obs.instant`): lightweight spans buffered in a
+  per-process ring buffer and shipped to the head, with trace/span ids
+  propagated inside control-plane RPC frames so one query or one ``fit()``
+  yields a single causally-linked trace across driver, head, agents and
+  executors. Disabled by default (``RAYDP_TPU_TRACE=1`` enables shipping);
+  the disabled fast path is one branch per span.
+- **Metrics** (`obs.metrics`): an always-on process-local registry of
+  counters/gauges/histograms (RPC latency, store bytes, dispatch batches,
+  task retries, streaming idle, estimator step/compile time), pushed to the
+  head with each trace flush and queryable via ``cluster.dump_metrics()``.
+- **Export** (`obs.export_trace`): writes Chrome-trace/Perfetto JSON — one
+  track per process/actor, spans plus instant events for retries/restarts/
+  fusion decisions. ``last_query_stats`` and estimator timings are derived
+  from the SAME spans, not parallel hand-rolled timers.
+
+This module is import-light by design (stdlib only): it is imported by the
+zygote and by ``python -S`` worker processes.
+"""
+
+from __future__ import annotations
+
+from raydp_tpu.obs.logging import get_logger, log
+from raydp_tpu.obs.metrics import metrics
+from raydp_tpu.obs.tracing import (
+    collect,
+    current_context,
+    enabled,
+    flush,
+    flush_throttled,
+    instant,
+    set_process_role,
+    span,
+    use_context,
+    with_context,
+)
+
+__all__ = [
+    "collect",
+    "current_context",
+    "enabled",
+    "export_trace",
+    "flush",
+    "flush_throttled",
+    "get_logger",
+    "instant",
+    "log",
+    "metrics",
+    "set_process_role",
+    "span",
+    "use_context",
+    "with_context",
+]
+
+
+def export_trace(path: str) -> str:
+    """Write the collected cluster trace as Chrome-trace/Perfetto JSON.
+    Lazy import: export touches the cluster API, which span/metric call
+    sites inside the cluster layer itself must never pull in at import."""
+    from raydp_tpu.obs.export import export_trace as _export
+
+    return _export(path)
+
+
+def dump_metrics() -> dict:
+    from raydp_tpu.obs.export import dump_metrics as _dump
+
+    return _dump()

@@ -1,0 +1,66 @@
+"""Structured logging for runtime processes.
+
+Replaces bare ``print``/``traceback.print_exc`` diagnostics in the cluster
+layer: every line carries a wall timestamp, the process role, and the actor
+id, so crash output interleaved from dozens of processes in the session dir's
+log files is attributable. Stdlib-only and import-light (the zygote and
+``python -S`` workers load this).
+
+Usage::
+
+    from raydp_tpu import obs
+    obs.log.error("actor init failed", exc_info=True)
+    obs.log.info("respawning", actor_id=aid, incarnation=2)
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import time
+import traceback
+
+
+class StructuredLogger:
+    """Writes ``ts level [role actor] message key=value...`` lines to stderr
+    (the per-process ``.err`` files the spawner already redirects there)."""
+
+    def __init__(self, role: str = ""):
+        self._role = role
+
+    def _emit(self, level: str, message: str, exc_info: bool, fields: dict) -> None:
+        from raydp_tpu.obs.tracing import process_role
+
+        role = self._role or process_role()
+        actor = os.environ.get("RAYDP_TPU_ACTOR_ID", "")
+        ts = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
+        parts = [ts, level, f"[{role}" + (f" {actor}" if actor else "") + "]", message]
+        if fields:
+            parts.append(" ".join(f"{k}={v!r}" for k, v in fields.items()))
+        line = " ".join(parts)
+        if exc_info:
+            line += "\n" + traceback.format_exc().rstrip()
+        try:
+            sys.stderr.write(line + "\n")
+            sys.stderr.flush()
+        except (OSError, ValueError):
+            pass  # a closed stderr at teardown must never raise
+
+    def info(self, message: str, exc_info: bool = False, **fields) -> None:
+        self._emit("INFO", message, exc_info, fields)
+
+    def warning(self, message: str, exc_info: bool = False, **fields) -> None:
+        self._emit("WARN", message, exc_info, fields)
+
+    def error(self, message: str, exc_info: bool = False, **fields) -> None:
+        self._emit("ERROR", message, exc_info, fields)
+
+    def exception(self, message: str, **fields) -> None:
+        self._emit("ERROR", message, True, fields)
+
+
+log = StructuredLogger()
+
+
+def get_logger(role: str) -> StructuredLogger:
+    return StructuredLogger(role)

@@ -1,0 +1,119 @@
+"""Process-local metrics registry: counters, gauges, histograms.
+
+Always on (the instruments are dict updates — far cheaper than any call site
+they sit in: RPCs, block writes, dispatch batches). Each process accumulates
+locally; snapshots ride to the head with every trace flush and the driver
+merges them via ``cluster.dump_metrics()``.
+
+Metric names are dotted strings; see docs/observability.md for the table of
+names the runtime emits.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict
+
+
+class Counter:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def inc(self, amount: float = 1.0) -> None:
+        self.value += amount
+
+    def snapshot(self):
+        return {"type": "counter", "value": self.value}
+
+
+class Gauge:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+    def set(self, value: float) -> None:
+        self.value = float(value)
+
+    def snapshot(self):
+        return {"type": "gauge", "value": self.value}
+
+
+class Histogram:
+    """count/sum/min/max summary — enough to answer "how many, how much,
+    how bad" without per-observation storage. (Quantiles would need
+    reservoirs; the trace has the individual spans when you need shape.)"""
+
+    __slots__ = ("count", "sum", "min", "max")
+
+    def __init__(self):
+        self.count = 0
+        self.sum = 0.0
+        self.min = float("inf")
+        self.max = float("-inf")
+
+    def observe(self, value: float) -> None:
+        value = float(value)
+        self.count += 1
+        self.sum += value
+        if value < self.min:
+            self.min = value
+        if value > self.max:
+            self.max = value
+
+    def snapshot(self):
+        if not self.count:
+            return {"type": "histogram", "count": 0, "sum": 0.0}
+        return {
+            "type": "histogram",
+            "count": self.count,
+            "sum": self.sum,
+            "min": self.min,
+            "max": self.max,
+            "mean": self.sum / self.count,
+        }
+
+
+class Registry:
+    """The per-process registry. Instruments are created on first use and
+    live for the process; lookups are one dict hit under a lock (creation
+    only — the instrument methods themselves are lock-free, fine for
+    float-add races whose worst case is a lost increment)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: Dict[str, Any] = {}
+
+    def _get(self, name: str, cls):
+        inst = self._instruments.get(name)
+        if inst is None:
+            with self._lock:
+                inst = self._instruments.setdefault(name, cls())
+        if not isinstance(inst, cls):
+            raise TypeError(
+                f"metric {name!r} already registered as {type(inst).__name__}"
+            )
+        return inst
+
+    def counter(self, name: str) -> Counter:
+        return self._get(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get(name, Histogram)
+
+    def snapshot(self) -> Dict[str, dict]:
+        with self._lock:
+            items = list(self._instruments.items())
+        return {name: inst.snapshot() for name, inst in items}
+
+    def reset(self) -> None:
+        with self._lock:
+            self._instruments.clear()
+
+
+metrics = Registry()

@@ -228,7 +228,12 @@ class WritableBlock:
 
 def _register(ref: ObjectRef, owner: Optional[str], shm_name: Optional[str] = None) -> None:
     from raydp_tpu.cluster.worker import current_context
+    from raydp_tpu.obs import metrics
 
+    metrics.counter("store.blocks_written").inc()
+    metrics.counter("store.bytes_written").inc(ref.size)
+    if (shm_name or "").startswith("file://"):
+        metrics.counter("store.blocks_spilled").inc()
     if cluster_api.is_tcp_client():
         raise ClusterError(
             "tcp:// client processes cannot host object-store blocks (no "
@@ -630,6 +635,10 @@ def get_buffer(ref: ObjectRef):
         data = parts[0] if len(parts) == 1 else b"".join(parts)
         stats["remote_fetches"] += 1
         stats["remote_bytes"] += len(data)
+        from raydp_tpu.obs import metrics
+
+        metrics.counter("store.remote_fetches").inc()
+        metrics.counter("store.remote_bytes").inc(len(data))
         if len(data) < size:
             raise ClusterError(
                 f"object {ref.object_id} remote fetch truncated: "

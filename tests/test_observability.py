@@ -1,0 +1,243 @@
+"""Observability plane tests: span API, metrics registry, trace propagation
+across processes, Perfetto export round-trip, last_query_stats schema.
+
+Real multi-process sessions (no mocks), like the rest of the suite: the
+export test asserts spans collected from MULTIPLE processes land in one
+Perfetto-loadable JSON under a shared trace id.
+"""
+
+import json
+import os
+import tempfile
+
+import pytest
+
+import raydp_tpu
+from raydp_tpu import obs
+from raydp_tpu.etl import functions as F
+from raydp_tpu.obs import tracing
+
+
+# ---------------------------------------------------------------------------
+# unit: span / collector / metrics primitives (no cluster needed)
+# ---------------------------------------------------------------------------
+
+
+def test_span_disabled_fast_path_is_noop():
+    assert not tracing.enabled() or os.environ.get("RAYDP_TPU_TRACE")
+    tracing.set_enabled(False)
+    s = obs.span("x", a=1)
+    assert s is tracing._NOOP
+    # no-op spans are context managers with a zero duration and a set() sink
+    with s as entered:
+        entered.set(b=2)
+    assert s.duration == 0.0
+
+
+def test_collector_captures_spans_and_instants():
+    with obs.collect() as got:
+        with obs.span("outer", k="v"):
+            with obs.span("inner"):
+                pass
+            obs.instant("marker", n=3)
+    names = [r["name"] for r in got]
+    # children finish (and record) before their parents
+    assert names == ["inner", "marker", "outer"]
+    outer = got[-1]
+    inner = got[0]
+    marker = got[1]
+    assert inner["trace"] == outer["trace"] == marker["trace"]
+    assert inner["parent"] == outer["id"]
+    assert marker["parent"] == outer["id"]
+    assert outer["args"]["k"] == "v"
+    assert outer["dur"] >= inner["dur"] >= 0
+
+
+def test_collectors_nest_independently():
+    with obs.collect() as outer_got:
+        with obs.span("a"):
+            pass
+        with obs.collect() as inner_got:
+            with obs.span("b"):
+                pass
+    assert [r["name"] for r in inner_got] == ["b"]
+    assert [r["name"] for r in outer_got] == ["a", "b"]
+
+
+def test_metrics_registry_snapshot():
+    from raydp_tpu.obs.metrics import Registry
+
+    reg = Registry()
+    reg.counter("c").inc()
+    reg.counter("c").inc(2.5)
+    reg.gauge("g").set(7)
+    for v in (1.0, 3.0):
+        reg.histogram("h").observe(v)
+    snap = reg.snapshot()
+    assert snap["c"] == {"type": "counter", "value": 3.5}
+    assert snap["g"] == {"type": "gauge", "value": 7.0}
+    assert snap["h"]["count"] == 2 and snap["h"]["sum"] == 4.0
+    assert snap["h"]["min"] == 1.0 and snap["h"]["max"] == 3.0
+    with pytest.raises(TypeError):
+        reg.gauge("c")  # type confusion must fail loudly
+
+
+def test_ring_buffer_bounded_and_drop_counted():
+    tracing.set_enabled(True)
+    try:
+        tracing.drain_local()
+        cap = tracing._buffer.maxlen
+        before_dropped = tracing.dropped_count()
+        for i in range(cap + 7):
+            tracing._buffer_append({"name": f"s{i}", "ts": 0, "dur": 0,
+                                    "pid": 0, "tid": 0})
+        assert len(tracing._buffer) <= cap
+        assert tracing.dropped_count() >= before_dropped
+    finally:
+        tracing.drain_local()
+        tracing.set_enabled(False)
+
+
+# ---------------------------------------------------------------------------
+# integration: traced session → head aggregation → Perfetto export
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def traced_session():
+    tracing.set_enabled(True)
+    os.environ["RAYDP_TPU_TRACE"] = "1"
+    s = raydp_tpu.init_etl(
+        "test-obs", num_executors=2, executor_cores=1,
+        executor_memory="300M",
+        # executors may join a cluster whose head predates this module —
+        # enable tracing in their spawn env explicitly
+        configs={"etl.actor.env.RAYDP_TPU_TRACE": "1"},
+    )
+    yield s
+    raydp_tpu.stop_etl()
+    tracing.set_enabled(False)
+    os.environ.pop("RAYDP_TPU_TRACE", None)
+
+
+def test_last_query_stats_schema(traced_session):
+    """The stats schema downstream consumers (bench etl_breakdown, docs)
+    rely on: stable top-level keys, stable per-stage keys, fusion entries."""
+    df = (
+        traced_session.range(200, num_partitions=4)
+        .with_column("x", F.col("id") * 2)
+        .with_column("y", F.col("x") + 1)
+        .select("id", "y")
+    )
+    table = df.to_arrow()
+    assert table.num_rows == 200
+    stats = traced_session.last_query_stats
+    assert set(stats) == {"seconds", "output_partitions", "stages", "fusion"}
+    assert stats["seconds"] > 0
+    assert stats["output_partitions"] >= 1
+    assert stats["stages"], "at least one stage must be recorded"
+    for stage in stats["stages"]:
+        # per-stage schema: task count, wall seconds, locality + dispatch
+        # mode, and the server-side read/compute/emit phase split
+        assert {"tasks", "seconds", "locality_preferred", "dispatch",
+                "server_seconds", "read_s", "compute_s", "emit_s"} <= set(
+            stage
+        ), stage
+        assert stage["dispatch"] in ("per_task", "batched")
+        assert stage["tasks"] >= 1
+        assert stage["seconds"] >= 0
+    # two adjacent Projects fused into one → a recorded fusion decision
+    assert stats["fusion"], stats
+    for decision in stats["fusion"]:
+        assert {"narrow_ops", "fused_ops"} <= set(decision)
+        assert decision["fused_ops"] < decision["narrow_ops"]
+
+
+def test_export_trace_perfetto_round_trip(traced_session):
+    """export_trace output is valid JSON in the Chrome trace-event format
+    Perfetto loads: every event carries ph/ts/pid/tid/name, spans from more
+    than one process appear, and a driver stage span and an executor task
+    span link under ONE trace id."""
+    df = traced_session.range(500, num_partitions=6).with_column(
+        "z", F.col("id") + 1
+    )
+    assert df.count() == 500
+    path = os.path.join(tempfile.mkdtemp(), "trace.json")
+    out = raydp_tpu.export_trace(path)
+    assert out == path
+    with open(path) as f:
+        doc = json.load(f)
+    events = doc["traceEvents"]
+    assert events, "trace must contain events"
+    for event in events:
+        for key in ("ph", "ts", "pid", "tid", "name"):
+            assert key in event, (key, event)
+    complete = [e for e in events if e["ph"] == "X"]
+    assert complete, "no complete spans in trace"
+    for event in complete:
+        assert "dur" in event
+    # process-name metadata gives each runtime process a labeled track
+    meta = [e for e in events if e["ph"] == "M"]
+    assert meta and all(e["name"] == "process_name" for e in meta)
+    # spans from >1 process (driver + at least one executor actor)
+    pids = {e["pid"] for e in complete}
+    assert len(pids) >= 2, f"expected multi-process trace, got pids={pids}"
+    # causal link: executor-side task spans carry the DRIVER's trace id
+    stage = [e for e in complete if e["name"] == "etl.stage"]
+    tasks = [e for e in complete if e["name"] == "task.run"]
+    assert stage and tasks
+    stage_traces = {e["args"]["trace_id"] for e in stage}
+    assert any(
+        e["args"]["trace_id"] in stage_traces for e in tasks
+    ), "executor task spans not linked to a driver stage trace"
+
+
+def test_dump_metrics_merges_processes(traced_session):
+    df = traced_session.range(300, num_partitions=4).with_column(
+        "w", F.col("id") * 3
+    )
+    assert df.count() == 300
+    merged = raydp_tpu.dump_metrics()
+    assert merged, "no metrics collected"
+    # driver registry present and counting RPCs
+    driver_keys = [k for k in merged if k.startswith("driver:")]
+    assert driver_keys
+    assert merged[driver_keys[0]]["rpc.client.calls"]["value"] > 0
+    # at least one worker process flushed its registry (tasks ran there)
+    flat = {
+        name for snap in merged.values() for name in snap
+    }
+    assert "etl.tasks_run" in flat
+
+
+def test_trace_disabled_leaves_stats_working(traced_session):
+    """With tracing off, query stats still derive from (collector-only)
+    spans — the obs layer is the one timing source either way."""
+    tracing.set_enabled(False)
+    try:
+        df = traced_session.range(100, num_partitions=2).with_column(
+            "q", F.col("id") + 5
+        )
+        assert df.count() == 100
+        stats = traced_session.last_query_stats
+        assert stats["stages"] and stats["seconds"] > 0
+    finally:
+        tracing.set_enabled(True)
+
+
+def test_structured_logger_format(capsys):
+    from raydp_tpu.obs.logging import get_logger
+
+    log = get_logger("testrole")
+    log.error("something broke", code=7)
+    err = capsys.readouterr().err
+    assert "ERROR" in err
+    assert "[testrole" in err
+    assert "something broke" in err
+    assert "code=7" in err
+    try:
+        raise ValueError("inner detail")
+    except ValueError:
+        log.exception("with traceback")
+    err = capsys.readouterr().err
+    assert "inner detail" in err and "Traceback" in err
