@@ -104,8 +104,22 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     # returned (the reference's stop_spark_after_conversion pattern)
     ds = dataframe_to_dataset(df, _use_owner=True)
     etl_breakdown = _etl_breakdown(session.last_query_stats)
+    # shuffle-plane probe (separately timed, EXCLUDED from etl_query_s so it
+    # stays comparable across rounds): an M-map/R-reduce repartition on the
+    # same session — its etl_breakdown.shuffle reports blocks == M (indexed
+    # single-block map outputs), bytes, and the reduce start lag
+    t_sh = time.perf_counter()
+    df.repartition(3).count()
+    t_shuffle = time.perf_counter() - t_sh
+    shuffle_probe = {
+        # the probe's measured wall time LAST: _etl_breakdown also carries a
+        # "seconds" key (the count-query's span) that must not shadow the
+        # t_shuffle actually subtracted from etl_query_s below
+        **_etl_breakdown(session.last_query_stats),
+        "seconds": round(t_shuffle, 4),
+    }
     raydp_tpu.stop_etl(cleanup_data=False, del_obj_holder=False)
-    t_query = time.perf_counter() - t0
+    t_query = time.perf_counter() - t0 - t_shuffle
     t_etl = t_boot + t_query
 
     est = JaxEstimator(
@@ -141,6 +155,7 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     )
     cmp["eval_sps"] = eval_throughput(est, ds, n_rows)
     cmp["etl_breakdown"] = etl_breakdown
+    cmp["shuffle_probe"] = shuffle_probe
     cmp.update(
         fair_e2e_fields(pandas_taxi_etl, pdf, trained, t_boot, t_query, cmp)
     )
@@ -172,6 +187,9 @@ def _etl_breakdown(stats):
         "seconds": round(stats.get("seconds", 0.0), 4),
         "stages": stages,
         "fusion": stats.get("fusion", []),
+        # per-exchange shuffle evidence: blocks written (M indexed vs M×R
+        # legacy), bytes, reduce start lag, dispatch mode
+        "shuffle": stats.get("shuffle", []),
     }
 
 

@@ -405,6 +405,24 @@ def safe_spill_path(name: str) -> str:
     return path
 
 
+def object_meta_entry(
+    object_id: str, owner: str, shm_name: str, size: int,
+    node_id: str, shm_ns: str = "",
+) -> Dict[str, Any]:
+    """The canonical metadata-registration record for one object-store
+    block — the single schema shared by the per-block ``object_put`` RPC and
+    the vectorized ``object_put_batch`` frame (store client side and head
+    handler side both build/consume exactly this shape)."""
+    return {
+        "object_id": object_id,
+        "owner": owner,
+        "shm_name": shm_name,
+        "size": size,
+        "node_id": node_id,
+        "shm_ns": shm_ns,
+    }
+
+
 def serve_block_bytes(shm_name: str, offset: int = 0, length: int = -1) -> bytes:
     """Read a local block for a remote reader (the block-server primitive
     shared by the head and node agents — one copy of the sanitize/seek/length

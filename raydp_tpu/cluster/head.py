@@ -831,33 +831,64 @@ class Head:
             )
         return True
 
+    def _meta_view(self, object_id: str, meta: "_ObjectMeta") -> dict:
+        """Client-facing lookup record for one object (lock held). Where a
+        non-local reader can pull the bytes: the owning node's agent, or the
+        head itself for head-node objects (parity: plasma locality +
+        RayDatasetRDD owner addresses, SURVEY §2.2 S8). The WRITER-recorded
+        namespace is authoritative — a tcp client's blocks carry its
+        namespace even though its "node" is the driver."""
+        if meta.owner_died:
+            raise OwnerDiedError(
+                f"object {object_id}: owner died and the object was not "
+                "transferred before the owner exited"
+            )
+        node = self.nodes.get(meta.node_id)
+        if node is not None and node.agent_addr is not None:
+            fetch_addr = node.agent_addr
+        else:
+            fetch_addr = self.tcp_addr
+        return {
+            "shm_name": meta.shm_name,
+            "size": meta.size,
+            "owner": meta.owner,
+            "node_id": meta.node_id,
+            "shm_ns": meta.shm_ns,
+            "fetch_addr": fetch_addr,
+        }
+
     def handle_object_lookup(self, object_id: str):
         with self.lock:
             meta = self.objects.get(object_id)
             if meta is None:
                 return None
-            if meta.owner_died:
-                raise OwnerDiedError(
-                    f"object {object_id}: owner died and the object was not "
-                    "transferred before the owner exited"
+            return self._meta_view(object_id, meta)
+
+    def handle_object_put_batch(self, entries: List[dict]):
+        """Vectorized registration: one RPC frame registers every block a
+        task batch produced (the per-block object_put is the hot metadata
+        call of the shuffle map side — M×R frames collapse to one per
+        task)."""
+        with self.lock:
+            for e in entries:
+                self.objects[e["object_id"]] = _ObjectMeta(
+                    e["object_id"], e["owner"], e["shm_name"], e["size"],
+                    e["node_id"], e.get("shm_ns", ""),
                 )
-            node = self.nodes.get(meta.node_id)
-            # where a non-local reader can pull the bytes: the owning node's
-            # agent, or the head itself for head-node objects (parity:
-            # plasma locality + RayDatasetRDD owner addresses, SURVEY §2.2 S8).
-            # The WRITER-recorded namespace is authoritative — a tcp client's
-            # blocks carry its namespace even though its "node" is the driver.
-            if node is not None and node.agent_addr is not None:
-                fetch_addr = node.agent_addr
-            else:
-                fetch_addr = self.tcp_addr
+        return True
+
+    def handle_object_lookup_batch(self, object_ids: List[str]):
+        """Vectorized lookup: {object_id: meta-or-None} in one frame (the
+        reduce side resolves every input slice's block with a single RPC).
+        An owner-died object raises, exactly like the single lookup."""
+        with self.lock:
             return {
-                "shm_name": meta.shm_name,
-                "size": meta.size,
-                "owner": meta.owner,
-                "node_id": meta.node_id,
-                "shm_ns": meta.shm_ns,
-                "fetch_addr": fetch_addr,
+                oid: (
+                    None
+                    if (meta := self.objects.get(oid)) is None
+                    else self._meta_view(oid, meta)
+                )
+                for oid in object_ids
             }
 
     def handle_object_locations(self, object_ids: List[str]):

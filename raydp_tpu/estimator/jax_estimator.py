@@ -996,12 +996,22 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
         jitted = jax.jit(epoch_body, donate_argnums=(0, 1) if donate else ())
 
+        from raydp_tpu.exchange.jax_io import SegmentUploader, iter_prefetch
+
+        # double-buffered upload staging: two reusable host buffers feed the
+        # async transfers (ping-pong recycled only after the transfer that
+        # used them completed); automatically degrades to per-segment
+        # allocation on CPU jax, where device_put zero-copy ALIASES host
+        # numpy buffers and reuse would corrupt the in-flight segment
+        uploader = SegmentUploader(mesh, depth=2)
         stats = self.stream_stats_ = {
             "bytes_uploaded": 0,
             "producer_idle_s": 0.0,
             "consumer_idle_s": 0.0,
             "segments": 0,
             "cached_epochs": 0,
+            "staging_buffer_reuse": uploader.reuse_host_buffers,
+            "staging_copies": 0,
         }
 
         def _produce_segments(host_iter, out_q: "queue.Queue", stop, coalesced):
@@ -1012,7 +1022,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             consumer unblock a producer parked on the full queue — an
             abandoned thread would pin the in-flight device segments
             forever. ``coalesced``: items are whole-segment slices
-            (reshaped zero-copy); otherwise per-batch items are stacked."""
+            (reshaped zero-copy); otherwise per-batch items are stacked.
+            The host iterator is itself prefetched one segment deep
+            (``iter_prefetch``), so segment k+1 DECODES while segment k's
+            async device_put is in flight — block IO, staging copy, and
+            transfer all overlap."""
 
             def _emit(item) -> bool:
                 from raydp_tpu import obs
@@ -1042,16 +1056,15 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     nbytes
                 )
                 obs.metrics.counter("estimator.stream.segments").inc()
-                return (
-                    _put_stacked_batch(mesh, hx),
-                    _put_stacked_batch(mesh, hy),
-                )
+                dx, dy = uploader.upload(hx, hy)
+                stats["staging_copies"] = uploader.staging_copies
+                return dx, dy
 
             try:
                 if coalesced:
                     from raydp_tpu.exchange.jax_io import coalesce_segment
 
-                    for x, y in host_iter:
+                    for x, y in iter_prefetch(host_iter, depth=1):
                         hx, hy, k = coalesce_segment(
                             x, np.asarray(y), batch_size
                         )
@@ -1062,7 +1075,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 else:
                     xs: List[Any] = []
                     ys: List[np.ndarray] = []
-                    for x, y in host_iter:
+                    for x, y in iter_prefetch(host_iter, depth=1):
                         xs.append(_fmap(np.asarray, x))
                         ys.append(np.asarray(y))
                         if len(xs) == seg:

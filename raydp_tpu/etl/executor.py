@@ -28,6 +28,13 @@ class EtlExecutor:
         # executor is sized by its CPU resource, not the whole machine
         os.environ.setdefault("OMP_NUM_THREADS", "1")
         os.environ.setdefault("ARROW_DEFAULT_THREADS", "1")
+        # planner.arrow_threads: multi-core deployments opt in to arrow's
+        # kernel threading on the group_by/join hot paths (default off — the
+        # pools above are sized for resource-isolated executors)
+        T.set_arrow_threads(
+            str(self.configs.get("planner.arrow_threads", "false")).lower()
+            in ("1", "true", "yes")
+        )
         self._warm_up()
 
     def _pool(self):
@@ -132,6 +139,43 @@ class EtlExecutor:
             )
         self._ship_telemetry()
         return results
+
+    def run_shuffle(
+        self,
+        map_specs: List[T.TaskSpec],
+        reduce_protos: List[T.TaskSpec],
+        schema_ipc: bytes,
+        num_reducers: int,
+    ):
+        """Fused map→reduce exchange in ONE dispatch: when every partition
+        of a shuffle is co-located on this executor (single-executor pools),
+        the driver round trip between the rounds buys nothing — run the map
+        tasks, transpose their outputs into per-reducer reads LOCALLY, and
+        run the reduce tasks, all inside this one RPC. ``reduce_protos`` are
+        complete reduce TaskSpecs except for their (placeholder) primary
+        read, filled here from the map results. Returns
+        ``(map_results, reduce_results)`` — the driver still owns cleanup
+        of the intermediate blocks."""
+        from raydp_tpu import obs
+
+        ctx = obs.current_context()
+
+        def _fanout(specs: List[T.TaskSpec]) -> List[T.TaskResult]:
+            if len(specs) <= 1 or self.cores <= 1:
+                return [self._run_one(s) for s in specs]
+            return list(
+                self._pool().map(
+                    lambda s: obs.with_context(ctx, self._run_one, s), specs
+                )
+            )
+
+        map_results = _fanout(map_specs)
+        reads = T.build_shuffle_reads(map_results, num_reducers, schema_ipc)
+        for r, proto in enumerate(reduce_protos):
+            proto.reads = [reads[r]]
+        reduce_results = _fanout(reduce_protos)
+        self._ship_telemetry()
+        return map_results, reduce_results
 
     # -- data plane (exchange layer reads, SURVEY.md §3.6 analog) --
 

@@ -55,6 +55,11 @@ class Planner:
         # project-fusion rewrite (collapse adjacent Projects into one):
         # tests flip this off to verify fused == unfused byte-identically
         self.fuse_projects = True
+        # indexed shuffle blocks: a map task writes ONE block holding all R
+        # splits (+offset footer) instead of R blocks — M metadata RPCs and
+        # M objects per shuffle instead of M×R. Tests flip this off to
+        # verify indexed == legacy byte-identically.
+        self.shuffle_indexed_blocks = True
         # per-executor parallel task slots (the session sets this to
         # executor_cores, matching the executor-side run_tasks thread pool);
         # sizes the reply-timeout budget of batched dispatches
@@ -96,6 +101,7 @@ class Planner:
         self._inflight_lock = threading.Lock()
         self.__dict__.setdefault("fuse_projects", True)
         self.__dict__.setdefault("executor_slots", 1)
+        self.__dict__.setdefault("shuffle_indexed_blocks", True)
 
     # ------------------------------------------------------------------
     # task submission
@@ -185,12 +191,20 @@ class Planner:
             prefs.append(candidates[i % len(candidates)] if candidates else None)
         return prefs
 
-    def submit(self, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
+    def submit(
+        self,
+        specs: List[T.TaskSpec],
+        on_result: Optional[Callable[[int, T.TaskResult], None]] = None,
+    ) -> List[T.TaskResult]:
         """Run tasks across the pool; a task whose executor died mid-flight is
         retried on another executor (Spark task-retry parity — executor actors
         restart, so transient deaths must not fail the query). Only connection
         breakage retries: timeouts and remote application errors propagate
         (a slow task re-executed elsewhere would duplicate side effects).
+
+        ``on_result`` streams each task's (final, post-retry) result OUT OF
+        the gather loop as it lands — the map-completion notification feed
+        the barrier-free reduce start is built on.
 
         The whole stage runs inside an ``obs.span("etl.stage")`` — the SAME
         record that lands on the trace timeline is what ``last_query_stats``
@@ -213,7 +227,12 @@ class Planner:
         stage_span.__enter__()
         try:
             if not self.executors:
-                results = [T.run_task(s) for s in specs]
+                results = []
+                for i, s in enumerate(specs):
+                    result = T.run_task(s)
+                    results.append(result)
+                    if on_result is not None:
+                        on_result(i, result)
                 return results
             prefs = self._preferred_executors(specs)
             # one-dispatch batch path: a stage wider than the pool's task
@@ -221,13 +240,13 @@ class Planner:
             # run_tasks RPC instead of one round trip per task
             if len(specs) > len(self.executors):
                 batched = True
-                results = self._submit_batched(specs, prefs)
+                results = self._submit_batched(specs, prefs, on_result)
             else:
                 futures = [
                     (self._dispatch(spec, i, 0, prefs[i]), spec, i)
                     for i, spec in enumerate(specs)
                 ]
-                results = self._gather(futures, specs)
+                results = self._gather(futures, specs, on_result)
             return results
         finally:
             if hook is not None:
@@ -259,7 +278,10 @@ class Planner:
             stage_span.__exit__(None, None, None)
 
     def _submit_batched(
-        self, specs: List[T.TaskSpec], prefs: List[Optional[int]]
+        self,
+        specs: List[T.TaskSpec],
+        prefs: List[Optional[int]],
+        on_result: Optional[Callable[[int, T.TaskResult], None]] = None,
     ) -> List[T.TaskResult]:
         """Group tasks by executor (locality-preferred, round-robin filled)
         and dispatch each group as ONE run_tasks call — per-task actor round
@@ -304,6 +326,8 @@ class Planner:
                 batch = future.result()
                 for i, r in zip(group, batch):
                     results[i] = r
+                    if on_result is not None:
+                        on_result(i, r)
             except (ConnectionError, EOFError, _ActorDied):
                 from raydp_tpu import obs
 
@@ -320,12 +344,24 @@ class Planner:
                 (self._dispatch(dense_specs[j], fallback[j], 1), dense_specs[j], j)
                 for j in range(len(fallback))
             ]
-            retried = self._gather(retry_futures, dense_specs)
+            dense_cb = None
+            if on_result is not None:
+                on_result_fn = on_result
+
+                def dense_cb(j, r):
+                    on_result_fn(fallback[j], r)
+
+            retried = self._gather(retry_futures, dense_specs, dense_cb)
             for j, i in enumerate(fallback):
                 results[i] = retried[j]
         return results  # type: ignore[return-value]
 
-    def _gather(self, futures, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
+    def _gather(
+        self,
+        futures,
+        specs: List[T.TaskSpec],
+        on_result: Optional[Callable[[int, T.TaskResult], None]] = None,
+    ) -> List[T.TaskResult]:
         from raydp_tpu import obs
 
         results: List[Optional[T.TaskResult]] = [None] * len(specs)
@@ -342,10 +378,65 @@ class Planner:
                     )
                     obs.metrics.counter("etl.task_retries").inc()
                     retry.append((self._dispatch(spec, i, attempt + 1), spec, i))
+                    continue
+                if on_result is not None:
+                    on_result(i, results[i])
             if not retry:
                 break
             futures = retry
         return results  # type: ignore[return-value]
+
+    def gather_predispatched(
+        self,
+        futures: List[Optional[Any]],
+        specs: List[T.TaskSpec],
+    ) -> List[T.TaskResult]:
+        """Stage bookkeeping for tasks whose DISPATCH already happened inside
+        the previous stage's gather loop (barrier-free reduce start): same
+        span, metrics, and retry ladder as ``submit()``; a ``None`` future
+        (its eager dispatch failed) is re-dispatched here through the normal
+        failover ladder."""
+        from raydp_tpu import obs
+
+        hook = self.scale_hook
+        if hook is not None:
+            with self._inflight_lock:
+                self._inflight += 1
+            try:
+                hook(len(specs))
+            except Exception:
+                pass
+        stage_span = obs.span("etl.stage", tasks=len(specs))
+        stage_span.__enter__()
+        try:
+            triples = []
+            for i, (future, spec) in enumerate(zip(futures, specs)):
+                if future is None:
+                    future = self._dispatch(spec, i, 0)
+                triples.append((future, spec, i))
+            results = self._gather(triples, specs)
+            return results
+        finally:
+            if hook is not None:
+                with self._inflight_lock:
+                    self._inflight -= 1
+            stage_span.set(dispatch="pipelined")
+            obs.metrics.counter("etl.stages").inc()
+            obs.metrics.counter("etl.tasks_dispatched").inc(len(specs))
+            try:
+                stage_span.set(
+                    server_seconds=round(
+                        sum(r.server_seconds for r in results), 6
+                    ),
+                    read_s=round(sum(r.read_seconds for r in results), 6),
+                    compute_s=round(
+                        sum(r.compute_seconds for r in results), 6
+                    ),
+                    emit_s=round(sum(r.emit_seconds for r in results), 6),
+                )
+            except (NameError, AttributeError):
+                pass  # dispatch raised before results existed
+            stage_span.__exit__(None, None, None)
 
     # ------------------------------------------------------------------
     # schema inference (run the pipeline on empty tables, locally)
@@ -416,7 +507,7 @@ class Planner:
         if isinstance(node, lp.Join):
             left = self._empty_result(node.left)
             right = self._empty_result(node.right)
-            return left.join(right, keys=node.on, join_type=node.how, use_threads=False)
+            return left.join(right, keys=node.on, join_type=node.how, use_threads=T.arrow_threads())
         if isinstance(node, (lp.Sort, lp.Distinct)):
             return self._empty_result(node.children()[0])
         if isinstance(node, lp.Window):
@@ -644,6 +735,7 @@ class Planner:
             self._tls.query_active = False
         stages = []
         fusion = []
+        shuffle = []
         for record in records:
             if record["name"] == "etl.stage":
                 args = record["args"]
@@ -657,11 +749,17 @@ class Planner:
                 stages.append(entry)
             elif record["name"] == "etl.fusion":
                 fusion.append(dict(record["args"]))
+            elif record["name"] == "etl.shuffle":
+                # one entry per exchange: blocks written (M indexed vs M×R
+                # legacy), bytes, reduce start lag — the shuffle data
+                # plane's own evidence in query stats / etl_breakdown
+                shuffle.append(dict(record["args"]))
         self.last_query_stats = {
             "seconds": query_span.duration,
             "output_partitions": len(results),
             "stages": stages,
             "fusion": fusion,
+            "shuffle": shuffle,
         }
         return results
 
@@ -777,21 +875,28 @@ class Planner:
         num_reducers: int,
         schema: pa.Schema,
     ) -> List[T.ReadSpec]:
-        """Transpose map-side split outputs into per-reducer ReadSpecs."""
-        schema_ipc = T.schema_ipc_bytes(schema)
-        reads = []
-        for r in range(num_reducers):
-            blocks = [
-                res.blocks[r]
-                for res in map_results
-                if r < len(res.blocks) and res.blocks[r] is not None
-            ]
-            reads.append(T.ReadSpec("block", blocks=blocks, schema_ipc=schema_ipc))
-        return reads
+        """Transpose map-side split outputs into per-reducer ReadSpecs
+        (delegates to the shared builder — indexed and legacy layouts)."""
+        return T.build_shuffle_reads(
+            map_results, num_reducers, T.schema_ipc_bytes(schema)
+        )
 
-    def _cleanup_intermediate(self, results: List[T.TaskResult]) -> None:
+    def _split_output(self, kind: str, **kw) -> T.OutputSpec:
+        """A shuffle map-side OutputSpec carrying the session's indexed-
+        block decision (ONE block per map task vs one per split)."""
+        return T.OutputSpec(
+            kind, indexed_splits=self.shuffle_indexed_blocks, **kw
+        )
+
+    def _cleanup_intermediate(self, results: List[Optional[T.TaskResult]]) -> None:
         self._delete_blocks(
-            [b for res in results for b in res.blocks if b is not None]
+            [
+                b
+                for res in results
+                if res is not None
+                for b in res.blocks
+                if b is not None
+            ]
         )
 
     @staticmethod
@@ -800,7 +905,209 @@ class Planner:
             try:
                 store.delete(refs)
             except Exception:
-                pass  # best-effort: shuffle temp blocks also die with their owner
+                # best-effort (shuffle temp blocks also die with their
+                # owner) — but COUNTED: silently leaked blocks were
+                # invisible before; now they surface in dump_metrics and
+                # as an instant on the trace timeline
+                from raydp_tpu import obs
+
+                obs.metrics.counter("store.delete_failures").inc(len(refs))
+                obs.instant("store.delete_failed", blocks=len(refs))
+
+    # ------------------------------------------------------------------
+    # shuffle exchange (barrier-free reduce start)
+    # ------------------------------------------------------------------
+
+    def _map_stage(
+        self,
+        node: lp.PlanNode,
+        output: T.OutputSpec,
+        launcher: "_ReduceLauncher",
+        side: int,
+    ) -> List[T.TaskResult]:
+        """Execute a shuffle's map side, streaming completions into the
+        launcher. When the plan's top is a single simple stage (source base
+        + narrow chain — the common case) completions flow task-by-task out
+        of the gather loop, so the reduce round starts the moment the last
+        input slice is registered instead of after stage teardown. Composite
+        map sides (union / limit / nested wide ops) fall back to the barrier
+        path and report results after the fact."""
+        specs = self._simple_map_specs(node, output)
+        if specs is not None:
+            launcher.begin_side(side, len(specs))
+            return self.submit(specs, on_result=launcher.observer(side))
+        results = self._execute(node, output)
+        launcher.begin_side(side, len(results))
+        observe = launcher.observer(side)
+        for i, r in enumerate(results):
+            observe(i, r)
+        return results
+
+    def _exchange(
+        self,
+        child: lp.PlanNode,
+        map_out: T.OutputSpec,
+        num_reducers: int,
+        schema: pa.Schema,
+        spec_fn,
+    ) -> List[T.TaskResult]:
+        """One-sided map→reduce exchange. Reduce tasks dispatch barrier-free
+        (per-reducer readiness from streamed map completions); on a single-
+        executor pool with a simple map side, the whole map→reduce graph
+        ships to the executor in ONE dispatch (`run_shuffle`) — co-located
+        partitions never pay a second driver round trip."""
+        fused = self._try_fused_exchange(
+            child, map_out, num_reducers, schema, spec_fn
+        )
+        if fused is not None:
+            return fused
+        launcher = _ReduceLauncher(
+            self, num_reducers, lambda r, reads: spec_fn(r, reads[0])
+        )
+        side = launcher.add_side(schema)
+        map_results = self._map_stage(child, map_out, launcher, side)
+        out = launcher.gather()
+        launcher.emit_stats(indexed=map_out.indexed_splits)
+        self._cleanup_intermediate(map_results)
+        return out
+
+    def _simple_map_specs(
+        self, child: lp.PlanNode, map_out: T.OutputSpec
+    ) -> Optional[List[T.TaskSpec]]:
+        """The map side as a flat spec list, when it is one simple stage."""
+        base, chain = self._split_narrow(child)
+        if not isinstance(
+            base,
+            (lp.ArrowSource, lp.RangeSource, lp.ParquetSource, lp.CsvSource),
+        ):
+            return None
+        shipped = self._prepare_chain(chain)
+        return [
+            T.TaskSpec(
+                reads=[r], chain=shipped, output=map_out, partition_index=i
+            )
+            for i, r in enumerate(self._source_reads(base))
+        ]
+
+    def _try_fused_exchange(
+        self,
+        child: lp.PlanNode,
+        map_out: T.OutputSpec,
+        num_reducers: int,
+        schema: pa.Schema,
+        spec_fn,
+    ) -> Optional[List[T.TaskResult]]:
+        """Single-executor pools skip the driver round trip between the map
+        and reduce rounds entirely: the executor runs the whole graph from
+        one ``run_shuffle`` dispatch (every partition is co-located by
+        construction). Falls back to the two-stage path on any delivery
+        failure — re-running both rounds is the same retry surface a batched
+        stage has."""
+        if len(self.executors) != 1:
+            return None
+        map_specs = self._simple_map_specs(child, map_out)
+        if map_specs is None:
+            return None
+        hook = self.scale_hook
+        if hook is not None:
+            # dynamic allocation gets its pre-dispatch look at the stage
+            # width exactly as submit() would give it; if the pool grows,
+            # the single-executor fused path no longer applies
+            try:
+                hook(len(map_specs))
+            except Exception:
+                pass
+            if len(self.executors) != 1:
+                return None
+        from raydp_tpu import obs
+
+        schema_ipc = T.schema_ipc_bytes(schema)
+        protos = [
+            spec_fn(r, T.ReadSpec("block", schema_ipc=schema_ipc))
+            for r in range(num_reducers)
+        ]
+        waves = -(
+            -(len(map_specs) + num_reducers) // max(1, self.executor_slots)
+        )
+        if hook is not None:
+            # the inflight guard keeps dynamic deallocation from killing
+            # this executor under the in-flight fused dispatch
+            with self._inflight_lock:
+                self._inflight += 1
+        delivery_failed = False
+        try:
+            # with-block: the stage span closes on EVERY exit path — an
+            # application error propagating out of the fused dispatch must
+            # not leave the span open (it would vanish from query stats and
+            # mis-parent later spans under a dead context)
+            with obs.span(
+                "etl.stage", tasks=len(map_specs) + num_reducers
+            ) as stage_span:
+                try:
+                    map_results, out = (
+                        self.executors[0]
+                        .run_shuffle.options(timeout=300.0 * waves)
+                        .remote(map_specs, protos, schema_ipc, num_reducers)
+                        .result()
+                    )
+                except (ConnectionError, EOFError, _ActorDied):
+                    delivery_failed = True
+                except AttributeError as exc:
+                    # ONLY the missing-method signature of an older executor
+                    # falls back; a genuine AttributeError inside a task
+                    # body must propagate, not silently re-run the exchange
+                    if "run_shuffle" not in str(exc):
+                        raise
+                    delivery_failed = True
+                if delivery_failed:
+                    # schema-conformant failure record: consumers iterate
+                    # stages expecting the phase keys to exist
+                    stage_span.set(
+                        dispatch="fused_failed", server_seconds=0.0,
+                        read_s=0.0, compute_s=0.0, emit_s=0.0,
+                    )
+                else:
+                    stage_span.set(
+                        dispatch="fused",
+                        server_seconds=round(
+                            sum(r.server_seconds for r in map_results + out), 6
+                        ),
+                        read_s=round(
+                            sum(r.read_seconds for r in map_results + out), 6
+                        ),
+                        compute_s=round(
+                            sum(r.compute_seconds for r in map_results + out), 6
+                        ),
+                        emit_s=round(
+                            sum(r.emit_seconds for r in map_results + out), 6
+                        ),
+                    )
+        finally:
+            if hook is not None:
+                with self._inflight_lock:
+                    self._inflight -= 1
+        if delivery_failed:
+            return None
+        obs.metrics.counter("etl.stages").inc()
+        obs.metrics.counter("etl.tasks_dispatched").inc(
+            len(map_specs) + num_reducers
+        )
+        obs.metrics.counter("etl.fused_exchanges").inc()
+        blocks = [
+            b for res in map_results for b in res.blocks if b is not None
+        ]
+        obs.instant(
+            "etl.shuffle",
+            map_tasks=len(map_specs),
+            reducers=num_reducers,
+            blocks=len(blocks),
+            bytes=sum(b.size for b in blocks),
+            indexed=bool(map_out.indexed_splits),
+            dispatch="fused",
+            reduce_start_lag_s=0.0,
+        )
+        self._delete_blocks(blocks)
+        return out
 
     def _execute_repartition(
         self, offset: int, base: lp.Repartition, chain: List[lp.PlanNode], output: T.OutputSpec
@@ -808,13 +1115,11 @@ class Planner:
         n = self._num_partitions(base.num_partitions)
         child_schema = self.infer_schema(base.child)
         if base.by:
-            map_out = T.OutputSpec("hash_split", num_splits=n, keys=list(base.by))
+            map_out = self._split_output("hash_split", num_splits=n, keys=list(base.by))
         elif base.shuffle_seed is not None:
-            map_out = T.OutputSpec("random_split", num_splits=n, seed=base.shuffle_seed)
+            map_out = self._split_output("random_split", num_splits=n, seed=base.shuffle_seed)
         else:
-            map_out = T.OutputSpec("round_robin_split", num_splits=n)
-        map_results = self._execute(base.child, map_out)
-        reads = self._shuffle_reads(map_results, n, child_schema)
+            map_out = self._split_output("round_robin_split", num_splits=n)
         shuffle_seed = base.shuffle_seed
         reduce_chain = list(chain)
         if shuffle_seed is not None:
@@ -822,19 +1127,17 @@ class Planner:
             reduce_chain = [
                 lp.MapBatches(None, _IntraShuffle(shuffle_seed))  # type: ignore[arg-type]
             ] + reduce_chain
-        specs = [
-            T.TaskSpec(
-                reads=[r],
+
+        def spec_fn(i, read):
+            return T.TaskSpec(
+                reads=[read],
                 merge=T.MergeSpec("none"),
                 chain=reduce_chain,
                 output=output,
                 partition_index=offset + i,
             )
-            for i, r in enumerate(reads)
-        ]
-        out = self.submit(specs)
-        self._cleanup_intermediate(map_results)
-        return out
+
+        return self._exchange(base.child, map_out, n, child_schema, spec_fn)
 
     def _execute_groupby(
         self, offset: int, base: lp.GroupByAgg, chain: List[lp.PlanNode], output: T.OutputSpec
@@ -844,35 +1147,25 @@ class Planner:
             base.child, _PartialAgg(base.keys, base.aggs)
         )
         if base.keys:
-            map_out = T.OutputSpec("hash_split", num_splits=n, keys=list(base.keys))
+            map_out = self._split_output("hash_split", num_splits=n, keys=list(base.keys))
         else:
             map_out = T.OutputSpec("block")  # single reducer merges all partials
-        map_results = self._execute(partial, map_out)
         partial_schema = T.partial_agg(
             self._empty_result(base.child), base.keys, base.aggs
         ).schema
-        if base.keys:
-            reads = self._shuffle_reads(map_results, n, partial_schema)
-        else:
-            blocks = [res.blocks[0] for res in map_results if res.blocks and res.blocks[0]]
-            reads = [
-                T.ReadSpec(
-                    "block", blocks=blocks, schema_ipc=T.schema_ipc_bytes(partial_schema)
-                )
-            ]
-        specs = [
-            T.TaskSpec(
-                reads=[r],
-                merge=T.MergeSpec("final_agg", keys=list(base.keys), aggs=list(base.aggs)),
+
+        def spec_fn(i, read):
+            return T.TaskSpec(
+                reads=[read],
+                merge=T.MergeSpec(
+                    "final_agg", keys=list(base.keys), aggs=list(base.aggs)
+                ),
                 chain=chain,
                 output=output,
                 partition_index=offset + i,
             )
-            for i, r in enumerate(reads)
-        ]
-        out = self.submit(specs)
-        self._cleanup_intermediate(map_results)
-        return out
+
+        return self._exchange(partial, map_out, n, partial_schema, spec_fn)
 
     # joins whose semantics survive broadcasting only the RIGHT side: each
     # left partition independently emits its complete result (right/full
@@ -962,71 +1255,98 @@ class Planner:
         )
         if base.partition_by:
             n = self._num_partitions(base.num_partitions)
-            map_results = self._execute(
-                base.child,
-                T.OutputSpec(
-                    "hash_split", num_splits=n, keys=list(base.partition_by)
-                ),
+            map_out = self._split_output(
+                "hash_split", num_splits=n, keys=list(base.partition_by)
             )
-            reads = self._shuffle_reads(map_results, n, child_schema)
         else:
-            map_results = self._execute(base.child, T.OutputSpec("block"))
-            blocks = [
-                res.blocks[0]
-                for res in map_results
-                if res.blocks and res.blocks[0] is not None
-            ]
-            reads = [
-                T.ReadSpec(
-                    "block", blocks=blocks,
-                    schema_ipc=T.schema_ipc_bytes(child_schema),
-                )
-            ]
-        specs = [
-            T.TaskSpec(
-                reads=[r],
+            n = 1
+            map_out = T.OutputSpec("block")
+
+        def spec_fn(i, read):
+            return T.TaskSpec(
+                reads=[read],
                 merge=T.MergeSpec("none"),
                 chain=[apply_node] + chain,
                 output=output,
                 partition_index=offset + i,
             )
-            for i, r in enumerate(reads)
-        ]
-        out = self.submit(specs)
-        self._cleanup_intermediate(map_results)
-        return out
+
+        return self._exchange(base.child, map_out, n, child_schema, spec_fn)
 
     def _execute_join(
         self, offset: int, base: lp.Join, chain: List[lp.PlanNode], output: T.OutputSpec
     ) -> List[T.TaskResult]:
+        """Shuffle join: BOTH map rounds run concurrently (the reference —
+        and the pre-pipelined planner — ran them serially, a full driver
+        barrier between two independent stages), and each join reducer
+        dispatches as soon as its left AND right input slices are all
+        registered."""
+        import threading
+
+        from raydp_tpu import obs
+
         if self._broadcast_side(base) == "right":
             return self._execute_broadcast_join(offset, base, chain, output)
         n = self._num_partitions(base.num_partitions)
         left_schema = self.infer_schema(base.left)
         right_schema = self.infer_schema(base.right)
-        left_results = self._execute(
-            base.left, T.OutputSpec("hash_split", num_splits=n, keys=list(base.on))
-        )
-        right_results = self._execute(
-            base.right, T.OutputSpec("hash_split", num_splits=n, keys=list(base.on))
-        )
-        left_reads = self._shuffle_reads(left_results, n, left_schema)
-        right_reads = self._shuffle_reads(right_results, n, right_schema)
-        specs = [
-            T.TaskSpec(
-                reads=[left_reads[i]],
+        # infer the RIGHT schema here too: schema inference mutates plan-node
+        # caches, which must not race the left side's inference on two threads
+
+        def spec_fn(i, side_reads):
+            return T.TaskSpec(
+                reads=[side_reads[0]],
                 merge=T.MergeSpec(
-                    "join", keys=list(base.on), right=right_reads[i], join_how=base.how
+                    "join", keys=list(base.on), right=side_reads[1],
+                    join_how=base.how,
                 ),
                 chain=chain,
                 output=output,
                 partition_index=offset + i,
             )
-            for i in range(n)
-        ]
-        out = self.submit(specs)
+
+        launcher = _ReduceLauncher(self, n, spec_fn)
+        left_side = launcher.add_side(left_schema)
+        right_side = launcher.add_side(right_schema)
+        map_out_left = self._split_output(
+            "hash_split", num_splits=n, keys=list(base.on)
+        )
+        map_out_right = self._split_output(
+            "hash_split", num_splits=n, keys=list(base.on)
+        )
+        right_box: dict = {}
+        ctx = obs.current_context()
+        sinks = obs.current_sinks()
+
+        def run_right():
+            # the worker thread adopts the query's collector sinks + trace
+            # context so its stage spans land in the same last_query_stats
+            with obs.use_sinks(sinks), obs.use_context(ctx):
+                try:
+                    right_box["results"] = self._map_stage(
+                        base.right, map_out_right, launcher, right_side
+                    )
+                except BaseException as exc:  # noqa: BLE001 - re-raised below
+                    launcher.abort()
+                    right_box["error"] = exc
+
+        thread = threading.Thread(target=run_right, daemon=True)
+        thread.start()
+        try:
+            left_results = self._map_stage(
+                base.left, map_out_left, launcher, left_side
+            )
+        except BaseException:
+            launcher.abort()
+            thread.join(timeout=300)
+            raise
+        thread.join()
+        if "error" in right_box:
+            raise right_box["error"]
+        out = launcher.gather()
+        launcher.emit_stats(indexed=self.shuffle_indexed_blocks)
         self._cleanup_intermediate(left_results)
-        self._cleanup_intermediate(right_results)
+        self._cleanup_intermediate(right_box.get("results", []))
         return out
 
     def _execute_sort(
@@ -1064,27 +1384,27 @@ class Planner:
             boundaries = pa.table(
                 {key: pa.array(np.asarray(bounds), child.schema.field(key).type)}
             )
-        # 2) range-split every partition
+        # 2) range-split every partition; 3) merge + sort each range —
+        # reduce tasks dispatch barrier-free as the splits complete
+        map_out = self._split_output(
+            "range_split",
+            num_splits=n,
+            keys=[key],
+            boundaries_ipc=T.table_to_ipc_bytes(boundaries),
+            ascending=list(base.ascending),
+        )
         map_specs = [
             T.TaskSpec(
                 reads=[T.ReadSpec("block", blocks=[b], schema_ipc=schema_ipc)],
-                output=T.OutputSpec(
-                    "range_split",
-                    num_splits=n,
-                    keys=[key],
-                    boundaries_ipc=T.table_to_ipc_bytes(boundaries),
-                    ascending=list(base.ascending),
-                ),
+                output=map_out,
                 partition_index=i,
             )
             for i, b in enumerate(child.blocks)
         ]
-        map_results = self.submit(map_specs)
-        reads = self._shuffle_reads(map_results, n, child.schema)
-        # 3) merge + sort each range
-        specs = [
-            T.TaskSpec(
-                reads=[r],
+
+        def spec_fn(i, side_reads):
+            return T.TaskSpec(
+                reads=[side_reads[0]],
                 merge=T.MergeSpec(
                     "sort", keys=list(base.keys), ascending=list(base.ascending)
                 ),
@@ -1092,9 +1412,13 @@ class Planner:
                 output=output,
                 partition_index=offset + i,
             )
-            for i, r in enumerate(reads)
-        ]
-        out = self.submit(specs)
+
+        launcher = _ReduceLauncher(self, n, spec_fn)
+        side = launcher.add_side(child.schema)
+        launcher.begin_side(side, len(map_specs))
+        map_results = self.submit(map_specs, on_result=launcher.observer(side))
+        out = launcher.gather()
+        launcher.emit_stats(indexed=map_out.indexed_splits)
         self._cleanup_intermediate(map_results)
         if child_is_fresh:
             self._delete_blocks([b for b in child.blocks if b is not None])
@@ -1107,23 +1431,23 @@ class Planner:
         child_schema = self.infer_schema(base.child)
         keys = list(child_schema.names)
         dedup = lp.MapBatches(base.child, _LocalDistinct())
-        map_results = self._execute(
-            dedup, T.OutputSpec("hash_split", num_splits=n, keys=keys)
-        )
-        reads = self._shuffle_reads(map_results, n, child_schema)
-        specs = [
-            T.TaskSpec(
-                reads=[r],
+
+        def spec_fn(i, read):
+            return T.TaskSpec(
+                reads=[read],
                 merge=T.MergeSpec("distinct"),
                 chain=chain,
                 output=output,
                 partition_index=offset + i,
             )
-            for i, r in enumerate(reads)
-        ]
-        out = self.submit(specs)
-        self._cleanup_intermediate(map_results)
-        return out
+
+        return self._exchange(
+            dedup,
+            self._split_output("hash_split", num_splits=n, keys=keys),
+            n,
+            child_schema,
+            spec_fn,
+        )
 
     def _materialize_limited(
         self, limit: lp.GlobalLimit
@@ -1169,6 +1493,148 @@ class Planner:
         return self.materialize(node), True
 
 
+class _ReduceLauncher:
+    """Barrier-free reduce start: per-reducer readiness tracked from
+    streamed map-completion notifications (``planner.submit``'s
+    ``on_result`` feed). The reduce round's tasks are DISPATCHED from inside
+    the map stage's gather loop the instant the last input slice is
+    registered — the driver never runs a post-stage barrier (transpose
+    reads → locality lookup → dispatch) between the rounds. Multi-side
+    exchanges (join) share one launcher: a reducer launches only when EVERY
+    side's inputs are complete, and the sides' map stages may stream in
+    from concurrent threads."""
+
+    def __init__(self, planner: Planner, num_reducers: int, spec_fn):
+        import threading
+
+        self.planner = planner
+        self.n = num_reducers
+        self.spec_fn = spec_fn  # (r, [ReadSpec per side]) -> TaskSpec
+        self._lock = threading.Lock()
+        self._sides: List[dict] = []
+        self._launched = False
+        self._aborted = False
+        self.specs: Optional[List[T.TaskSpec]] = None
+        self.futures: Optional[List[Optional[Any]]] = None
+        self.last_map_t: Optional[float] = None
+        self.dispatch_t: Optional[float] = None
+
+    def add_side(self, schema: pa.Schema) -> int:
+        self._sides.append(
+            {
+                "schema_ipc": T.schema_ipc_bytes(schema),
+                "results": None,  # per-map slot list, filled in map order
+                "seen": 0,
+            }
+        )
+        return len(self._sides) - 1
+
+    def begin_side(self, side: int, num_maps: int) -> None:
+        with self._lock:
+            if self._sides[side]["results"] is None:
+                self._sides[side]["results"] = [None] * num_maps
+
+    def observer(self, side: int):
+        def on_result(i: int, result: T.TaskResult) -> None:
+            self._observe(side, i, result)
+
+        return on_result
+
+    def _observe(self, side: int, i: int, result: T.TaskResult) -> None:
+        import time
+
+        with self._lock:
+            state = self._sides[side]
+            if state["results"][i] is None:
+                state["seen"] += 1
+            state["results"][i] = result
+            if self._aborted or self._launched:
+                return
+            if all(
+                s["results"] is not None and s["seen"] == len(s["results"])
+                for s in self._sides
+            ):
+                self.last_map_t = time.perf_counter()
+                self._launch()
+
+    def abort(self) -> None:
+        """A failing map side must not let a concurrent sibling trigger the
+        reduce round over partial inputs."""
+        with self._lock:
+            self._aborted = True
+
+    def _launch(self) -> None:
+        """Build every reducer's reads and dispatch (lock held). All input
+        slices are registered by construction — a map task's result only
+        arrives after its blocks did."""
+        import time
+
+        side_reads = [
+            T.build_shuffle_reads(
+                s["results"] or [], self.n, s["schema_ipc"]
+            )
+            for s in self._sides
+        ]
+        self.specs = [
+            self.spec_fn(r, [reads[r] for reads in side_reads])
+            for r in range(self.n)
+        ]
+        self.futures = [None] * self.n
+        self._launched = True
+        if not self.planner.executors:
+            return  # local mode: gather() runs the specs inline
+        self.dispatch_t = time.perf_counter()
+        for r, spec in enumerate(self.specs):
+            try:
+                self.futures[r] = self.planner._dispatch(spec, r, 0)
+            except Exception:
+                # eager dispatch is best-effort; gather()'s retry ladder
+                # re-dispatches a None slot through the normal failover
+                self.futures[r] = None
+
+    def gather(self) -> List[T.TaskResult]:
+        with self._lock:
+            if not self._launched:
+                # zero-map-task sides never stream a completion; launch with
+                # whatever (empty) inputs exist so reducers still run
+                self._launch()
+        if not self.planner.executors:
+            return self.planner.submit(self.specs)
+        return self.planner.gather_predispatched(self.futures, self.specs)
+
+    def emit_stats(self, indexed: bool) -> None:
+        """One ``etl.shuffle`` instant per exchange: block count (M for
+        indexed, up to M×R legacy), bytes, and the reduce start lag (time
+        from the last map completion to the reduce dispatch) — collected
+        into ``last_query_stats['shuffle']`` and the trace timeline."""
+        from raydp_tpu import obs
+
+        results = [
+            r
+            for s in self._sides
+            for r in (s["results"] or [])
+            if r is not None
+        ]
+        blocks = [
+            b for res in results for b in res.blocks if b is not None
+        ]
+        lag = (
+            self.dispatch_t - self.last_map_t
+            if self.dispatch_t is not None and self.last_map_t is not None
+            else 0.0
+        )
+        obs.instant(
+            "etl.shuffle",
+            map_tasks=len(results),
+            reducers=self.n,
+            blocks=len(blocks),
+            bytes=sum(b.size for b in blocks),
+            indexed=bool(indexed),
+            dispatch="pipelined",
+            reduce_start_lag_s=round(lag, 6),
+        )
+
+
 class _PartialAgg:
     """Picklable map-side aggregation closure."""
 
@@ -1182,7 +1648,7 @@ class _PartialAgg:
 
 class _LocalDistinct:
     def __call__(self, table: pa.Table) -> pa.Table:
-        return table.group_by(table.column_names, use_threads=False).aggregate([])
+        return table.group_by(table.column_names, use_threads=T.arrow_threads()).aggregate([])
 
 
 class _IntraShuffle:

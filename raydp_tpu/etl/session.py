@@ -198,6 +198,22 @@ class EtlSession:
             default_parallelism=self.default_parallelism,
             executor_slots=executor_cores,
         )
+        # shuffle data-plane knobs:
+        #   planner.shuffle_indexed_blocks (default on) — ONE indexed block
+        #     per map task (M objects per shuffle, not M×R); off = legacy
+        #     per-split blocks (the A/B path correctness tests compare)
+        #   planner.arrow_threads (default off) — arrow kernel threading on
+        #     group_by/join hot paths for multi-core deployments; plumbed to
+        #     the driver-local planner here and to executors via configs
+        self._planner.shuffle_indexed_blocks = str(
+            self.configs.get("planner.shuffle_indexed_blocks", "true")
+        ).lower() in ("1", "true", "yes")
+        from raydp_tpu.etl import tasks as _tasks
+
+        _tasks.set_arrow_threads(
+            str(self.configs.get("planner.arrow_threads", "false")).lower()
+            in ("1", "true", "yes")
+        )
 
         # dynamic allocation (reference: Spark's doRequestTotalExecutors /
         # doKillExecutors hooks, RayCoarseGrainedSchedulerBackend.scala:
@@ -249,15 +265,19 @@ class EtlSession:
     def from_arrow(
         self, table: pa.Table, num_partitions: Optional[int] = None
     ) -> DataFrame:
-        """Distribute a driver-local Table as object-store partitions."""
+        """Distribute a driver-local Table as object-store partitions (their
+        metadata registers in ONE batched RPC frame)."""
+        from raydp_tpu.store import object_store as store
+
         n = num_partitions or self.default_parallelism
         n = max(1, min(n, max(1, table.num_rows)))
         per = -(-table.num_rows // n)
         blocks = []
-        for i in range(n):
-            chunk = table.slice(i * per, per)
-            ref, _ = write_table_block(chunk)
-            blocks.append(ref)
+        with store.batched_registration():
+            for i in range(n):
+                chunk = table.slice(i * per, per)
+                ref, _ = write_table_block(chunk)
+                blocks.append(ref)
         return DataFrame(self, lp.ArrowSource(blocks, table.schema))
 
     def from_pandas(self, pdf, num_partitions: Optional[int] = None) -> DataFrame:

@@ -16,6 +16,7 @@ serialization, reference ObjectStoreWriter.scala:99-171) in Arrow-native form.
 from __future__ import annotations
 
 import os
+import struct
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
@@ -26,6 +27,30 @@ import pyarrow.compute as pc
 from raydp_tpu.etl import plan as lp
 from raydp_tpu.etl.expressions import AggExpr, _AGG_PHASES, _as_array
 from raydp_tpu.store import object_store as store
+
+# ---------------------------------------------------------------------------
+# Arrow kernel threading
+# ---------------------------------------------------------------------------
+
+# pyarrow's group_by/join kernels can fan out over arrow's internal thread
+# pool. Default OFF: executors are sized by their CPU resource (often 1-2
+# cores, like the CI box) and arrow's pool would oversubscribe the host.
+# Multi-core deployments opt in via the ``planner.arrow_threads`` session
+# conf (plumbed here by EtlSession/EtlExecutor).
+_ARROW_THREADS = False
+
+
+def set_arrow_threads(enabled: bool) -> None:
+    """Process-wide toggle for arrow kernel threading on the group_by/join
+    hot paths (the ``planner.arrow_threads`` session conf lands here, on the
+    driver AND in every executor)."""
+    global _ARROW_THREADS
+    _ARROW_THREADS = bool(enabled)
+
+
+def arrow_threads() -> bool:
+    return _ARROW_THREADS
+
 
 # ---------------------------------------------------------------------------
 # Block IO helpers
@@ -72,6 +97,114 @@ def read_table_block(ref: store.ObjectRef) -> pa.Table:
     return pa.Table.from_batches(batches, schema=schema)
 
 
+def read_table_block_slice(
+    ref: store.ObjectRef, offset: int, length: int, meta: Optional[dict] = None
+) -> pa.Table:
+    """Read ONE split of an indexed shuffle block: the ``[offset,
+    offset+length)`` range is a self-contained Arrow IPC stream (see
+    ``write_indexed_splits``). Local blocks stay zero-copy (the slice is a
+    window over the shm/spill mapping); remote blocks pull only the slice."""
+    schema, batches = store.read_arrow_batches(ref, offset, length, meta=meta)
+    return pa.Table.from_batches(batches, schema=schema)
+
+
+# Indexed shuffle block layout (one object per MAP TASK, not per split):
+#
+#   [split 0 IPC stream][split 1 IPC stream]...[split R-1 IPC stream]
+#   [footer: R × (u64 offset, u64 length)] [u32 R] [8-byte magic]
+#
+# Empty splits occupy zero bytes (their footer entry is (0, 0)). Each split
+# is a COMPLETE Arrow IPC stream (schema + batches + EOS), so any reducer
+# can decode its range with a plain stream reader. The footer makes the
+# block self-describing (``read_split_index``); the fast path never touches
+# it — the producing TaskResult carries the same offsets inline.
+SPLIT_INDEX_MAGIC = b"RTPUIDX1"
+_FOOTER_ENTRY = struct.Struct("<QQ")
+_FOOTER_TAIL = struct.Struct("<I8s")
+
+
+def write_indexed_splits(
+    splits: Sequence[pa.Table],
+    owner: Optional[str] = None,
+    max_records: int = DEFAULT_MAX_RECORDS_PER_BATCH,
+    storage: str = "auto",
+) -> Tuple[Optional[store.ObjectRef], List[Optional[Tuple[int, int]]], List[int]]:
+    """Write ALL of a map task's R shuffle splits as ONE object-store block
+    (concatenated IPC streams + offset-index footer) — M blocks per shuffle
+    instead of M×R, and one metadata registration instead of R. Returns
+    ``(ref, slices, counts)`` where ``slices[r]`` is the ``(offset, length)``
+    window reducer r range-reads, or None for an empty split; ``ref`` is
+    None when every split is empty."""
+    tables = [t.combine_chunks() if t.num_rows else t for t in splits]
+    if not any(t.num_rows for t in tables):
+        return None, [None] * len(tables), [0] * len(tables)
+
+    def _write_splits_to(sink):
+        """The ONE serializer of the block layout (both tiers write through
+        it — a layout change can't silently diverge between the shm path
+        and the memory-buffer fallback). Returns (slices, counts)."""
+        slices: List[Optional[Tuple[int, int]]] = []
+        counts: List[int] = []
+        for t in tables:
+            if t.num_rows == 0:
+                slices.append(None)
+                counts.append(0)
+                continue
+            start = sink.tell()
+            with pa.ipc.new_stream(sink, t.schema) as writer:
+                writer.write_table(t, max_chunksize=max_records)
+            slices.append((start, sink.tell() - start))
+            counts.append(t.num_rows)
+        for s in slices:
+            sink.write(_FOOTER_ENTRY.pack(*(s or (0, 0))))
+        sink.write(_FOOTER_TAIL.pack(len(tables), SPLIT_INDEX_MAGIC))
+        return slices, counts
+
+    capacity = sum(
+        int(t.nbytes) + (1 << 16) + 512 * max(1, t.num_columns)
+        for t in tables
+        if t.num_rows
+    ) + _FOOTER_ENTRY.size * len(tables) + _FOOTER_TAIL.size
+    block = store.create_block(capacity, storage=storage)
+    try:
+        sink = block.arrow_sink()
+        slices, counts = _write_splits_to(sink)
+        written = sink.tell()
+        sink.close()
+        ref = block.seal(written, owner=owner)
+        return ref, slices, counts
+    except Exception:
+        block.abort()
+        # conservative fallback (capacity estimate short / shm pressure):
+        # serialize to memory, then one put of the identical layout
+        out = pa.BufferOutputStream()
+        slices, counts = _write_splits_to(out)
+        ref = store.put(out.getvalue(), owner=owner, storage=storage)
+        return ref, slices, counts
+
+
+def read_split_index(ref: store.ObjectRef) -> List[Optional[Tuple[int, int]]]:
+    """Decode an indexed shuffle block's footer into the per-split
+    ``(offset, length)`` windows (None for empty splits) — the
+    self-describing path for consumers that only hold the ref."""
+    size = ref.size
+    tail = store.get_arrow_buffer(
+        ref, size - _FOOTER_TAIL.size, _FOOTER_TAIL.size
+    )
+    num_splits, magic = _FOOTER_TAIL.unpack(tail.to_pybytes())
+    if magic != SPLIT_INDEX_MAGIC:
+        raise ValueError(f"object {ref.object_id} is not an indexed shuffle block")
+    footer_len = _FOOTER_ENTRY.size * num_splits
+    entries = store.get_arrow_buffer(
+        ref, size - _FOOTER_TAIL.size - footer_len, footer_len
+    ).to_pybytes()
+    out: List[Optional[Tuple[int, int]]] = []
+    for i in range(num_splits):
+        offset, length = _FOOTER_ENTRY.unpack_from(entries, i * _FOOTER_ENTRY.size)
+        out.append((offset, length) if length else None)
+    return out
+
+
 def table_to_ipc_bytes(table: pa.Table) -> bytes:
     out = pa.BufferOutputStream()
     with pa.ipc.new_stream(out, table.schema) as writer:
@@ -101,6 +234,10 @@ class ReadSpec:
     inline_ipc: Optional[bytes] = None
     csv_options: Dict[str, Any] = field(default_factory=dict)
     schema_ipc: Optional[bytes] = None  # schema to use when inputs are empty
+    # indexed-shuffle inputs: (ref, offset, length) windows range-read out
+    # of map tasks' single-block outputs (write_indexed_splits); readable
+    # alongside ``blocks`` (legacy whole-block inputs)
+    slices: List[Tuple[store.ObjectRef, int, int]] = field(default_factory=list)
 
 
 @dataclass
@@ -129,6 +266,10 @@ class OutputSpec:
     owner: Optional[str] = None  # ownership target for produced blocks
     max_records: int = DEFAULT_MAX_RECORDS_PER_BATCH
     storage: str = "auto"  # block tier: "auto" | "shm" | "disk" (spill)
+    # *_split outputs: write ONE indexed block holding all splits (M blocks
+    # per shuffle instead of M×R) — the planner turns this on; the spec-level
+    # default keeps direct task construction on the legacy per-split layout
+    indexed_splits: bool = False
 
 
 @dataclass
@@ -143,10 +284,14 @@ class TaskSpec:
 @dataclass
 class TaskResult:
     """blocks[i] is the output for reducer i (block/…_split) or the single
-    output (block). ``None`` marks an empty split the reducer may skip."""
+    output (block). ``None`` marks an empty split the reducer may skip.
+    Indexed split outputs instead carry ONE block plus ``split_slices``:
+    ``split_slices[r]`` is reducer r's ``(offset, length)`` window into
+    ``blocks[0]`` (None = empty split)."""
 
     blocks: List[Optional[store.ObjectRef]] = field(default_factory=list)
     num_rows: List[int] = field(default_factory=list)
+    split_slices: Optional[List[Optional[Tuple[int, int]]]] = None
     inline_ipc: Optional[bytes] = None
     count: int = 0
     # server-side wall time of the task body (read→compute→emit), for query
@@ -168,6 +313,18 @@ class TaskResult:
 def _read_one(read: ReadSpec) -> pa.Table:
     if read.kind == "block":
         tables = [read_table_block(r) for r in read.blocks if r is not None]
+        if read.slices:
+            # one vectorized metadata lookup for every input slice's block,
+            # then a range read per slice (local: zero-copy window; remote:
+            # only the slice's bytes cross the wire)
+            from raydp_tpu.obs import metrics
+
+            metas = store.lookup_many([r for r, _, _ in read.slices])
+            metrics.counter("etl.shuffle.slice_fetches").inc(len(read.slices))
+            tables.extend(
+                read_table_block_slice(r, off, ln, meta=metas[r.object_id])
+                for r, off, ln in read.slices
+            )
         tables = [t for t in tables if t.num_rows > 0] or tables[:1]
         if not tables:
             if read.schema_ipc is not None:
@@ -213,6 +370,44 @@ def _empty_table(schema_ipc: bytes) -> pa.Table:
 
 def schema_ipc_bytes(schema: pa.Schema) -> bytes:
     return schema.serialize().to_pybytes()
+
+
+def build_shuffle_reads(
+    map_results: Sequence[Optional["TaskResult"]],
+    num_reducers: int,
+    schema_ipc: bytes,
+) -> List["ReadSpec"]:
+    """Transpose map-side outputs into per-reducer ReadSpecs — the ONE
+    implementation shared by the driver planner, the barrier-free reduce
+    launcher, and the executor-side fused map→reduce dispatch. Handles both
+    layouts: indexed single-block outputs (``split_slices`` windows) and
+    legacy per-split blocks. Map order is preserved (reducer input
+    concatenation order is part of the engine's determinism contract —
+    first/last aggregates depend on it)."""
+    reads: List[ReadSpec] = []
+    for r in range(num_reducers):
+        blocks: List[store.ObjectRef] = []
+        slices: List[Tuple[store.ObjectRef, int, int]] = []
+        for res in map_results:
+            if res is None:
+                continue
+            if res.split_slices is not None:
+                ref = res.blocks[0] if res.blocks else None
+                s = (
+                    res.split_slices[r]
+                    if r < len(res.split_slices)
+                    else None
+                )
+                if ref is not None and s is not None:
+                    slices.append((ref, s[0], s[1]))
+            elif r < len(res.blocks) and res.blocks[r] is not None:
+                blocks.append(res.blocks[r])
+        reads.append(
+            ReadSpec(
+                "block", blocks=blocks, slices=slices, schema_ipc=schema_ipc
+            )
+        )
+    return reads
 
 
 # ---------------------------------------------------------------------------
@@ -446,7 +641,7 @@ def partial_agg(table: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> pa
                 specs.append((col_name, "variance", pc.VarianceOptions(ddof=0)))
             else:
                 specs.append((col_name, map_agg))
-        grouped = table.group_by(keys, use_threads=False).aggregate(specs)
+        grouped = table.group_by(keys, use_threads=arrow_threads()).aggregate(specs)
         result = _grouped_positional(grouped, keys, [p for _, _, p in phases])
         for i, a in enumerate(aggs):
             if _is_moment_agg(a.agg):
@@ -551,7 +746,7 @@ def final_agg(partials: pa.Table, keys: List[str], aggs: Sequence[AggExpr]) -> p
             (pname, merge_fn)
             for (_, _, pname), merge_fn in zip(phases, _merge_fns(aggs))
         ]
-        merged = partials.group_by(keys, use_threads=False).aggregate(merge_specs)
+        merged = partials.group_by(keys, use_threads=arrow_threads()).aggregate(merge_specs)
         merged = _grouped_positional(merged, keys, [p for _, _, p in phases])
     else:
         arrays, names = [], []
@@ -730,7 +925,7 @@ def _read_and_merge(spec: TaskSpec) -> pa.Table:
         right = _read_one(spec.merge.right)
         return left.join(
             right, keys=spec.merge.keys, join_type=spec.merge.join_how,
-            use_threads=False,
+            use_threads=arrow_threads(),
         )
     table = (
         pa.concat_tables(tables, promote_options="permissive")
@@ -748,7 +943,7 @@ def _read_and_merge(spec: TaskSpec) -> pa.Table:
         )
     elif spec.merge.kind == "distinct":
         table = table.group_by(
-            table.column_names, use_threads=False
+            table.column_names, use_threads=arrow_threads()
         ).aggregate([])
     return table
 
@@ -876,14 +1071,29 @@ def _emit(table: pa.Table, spec: TaskSpec) -> TaskResult:
         raise ValueError(f"unknown output kind {out.kind!r}")
 
     splits = _split_table(table, indices.astype(np.int64), out.num_splits)
+    if out.indexed_splits:
+        # ONE block holds every split (+ offset-index footer): block count
+        # per shuffle drops from M×R to M and metadata registers in one RPC
+        ref, slices, counts = write_indexed_splits(
+            splits, owner=out.owner, max_records=out.max_records,
+        )
+        return TaskResult(
+            blocks=[ref] if ref is not None else [],
+            num_rows=counts,
+            split_slices=slices,
+        )
     refs: List[Optional[store.ObjectRef]] = []
     counts: List[int] = []
-    for sub in splits:
-        if sub.num_rows == 0:
-            refs.append(None)
-            counts.append(0)
-        else:
-            ref, n = write_table_block(sub, owner=out.owner, max_records=out.max_records)
-            refs.append(ref)
-            counts.append(n)
+    # legacy per-split blocks still register their metadata in ONE frame
+    with store.batched_registration():
+        for sub in splits:
+            if sub.num_rows == 0:
+                refs.append(None)
+                counts.append(0)
+            else:
+                ref, n = write_table_block(
+                    sub, owner=out.owner, max_records=out.max_records
+                )
+                refs.append(ref)
+                counts.append(n)
     return TaskResult(blocks=refs, num_rows=counts)

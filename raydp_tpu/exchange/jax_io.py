@@ -158,6 +158,160 @@ class PrefetchingDeviceIterator:
         return current
 
 
+def iter_prefetch(it: Iterator, depth: int = 1) -> Iterator:
+    """Background-thread iterator prefetch: up to ``depth`` items are pulled
+    ahead on a worker thread. The streaming segment producer wraps its host
+    iterator in this so segment k+1's host slice DECODES (block read →
+    numpy) while segment k's async ``device_put`` is still in flight —
+    without it, decode and upload serialize inside one producer loop.
+    Exceptions surface on the consuming side; the worker dies with the
+    consumer (daemon + sentinel drain on close)."""
+    import queue
+    import threading
+
+    q: "queue.Queue" = queue.Queue(maxsize=max(1, int(depth)))
+    _END = object()
+    stop = threading.Event()
+
+    def _pull():
+        try:
+            for item in it:
+                while not stop.is_set():
+                    try:
+                        q.put(item, timeout=0.2)
+                        break
+                    except queue.Full:
+                        continue
+                if stop.is_set():
+                    return
+            q.put(_END)
+        except BaseException as exc:  # noqa: BLE001 - re-raised consumer-side
+            q.put(exc)
+
+    worker = threading.Thread(target=_pull, daemon=True)
+    worker.start()
+    try:
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            if isinstance(item, BaseException):
+                raise item
+            yield item
+    finally:
+        stop.set()
+        try:
+            q.get_nowait()  # unblock a worker parked on the full queue
+        except Exception:
+            pass
+
+
+class SegmentUploader:
+    """Double-buffered streaming H2D: ``depth`` (default 2) reusable host
+    staging buffers feed ``device_put_stacked``. ``upload(hx, hy)`` copies
+    the segment into the least-recently-used buffer, starts the async
+    transfer, and returns the device arrays; a buffer is recycled only
+    after the transfer that last used it COMPLETED (``block_until_ready``
+    on the arrays from ``depth`` uploads ago — classic ping-pong). Stable
+    staging buffers mean the transport sees the same host pages every
+    segment instead of a fresh allocation per segment.
+
+    On backends where ``device_put``/``jnp.asarray`` may zero-copy ALIAS
+    host numpy memory (CPU jax — the hazard class behind the PR 2 resume
+    fix), buffer reuse is DISABLED automatically: the device array would
+    alias a buffer about to be overwritten two segments later. The
+    pipeline still overlaps decode with upload; it just allocates per
+    segment there."""
+
+    def __init__(self, mesh, axis: str = "data", depth: int = 2,
+                 reuse_host_buffers: Optional[bool] = None):
+        import jax
+
+        self._mesh = mesh
+        self._axis = axis
+        self._depth = max(2, int(depth))
+        if reuse_host_buffers is None:
+            reuse_host_buffers = jax.default_backend() != "cpu"
+        self.reuse_host_buffers = bool(reuse_host_buffers)
+        self._slots: list = [None] * self._depth
+        self._pending: list = [None] * self._depth
+        self._next = 0
+        self.staging_copies = 0
+
+    @staticmethod
+    def _leaves(hx, hy):
+        out = list(hx) if isinstance(hx, (tuple, list)) else [hx]
+        if hy is not None:
+            out.append(hy)
+        return out
+
+    def upload(self, hx, hy):
+        """Stage one [S, B, ...] segment and start its async device upload;
+        returns (device_x, device_y) shaped like the inputs."""
+        import jax
+
+        if self.reuse_host_buffers:
+            slot = self._next % self._depth
+            self._next += 1
+            inflight = self._pending[slot]
+            if inflight is not None:
+                # the transfer that used this buffer ``depth`` uploads ago:
+                # once its arrays are ready the bytes live on device and
+                # the host buffer is free to overwrite
+                jax.block_until_ready(inflight)
+                # belt and braces: on tunneled PJRT transports
+                # block_until_ready can return EARLY (see bench.py's fence
+                # notes) — a one-element VALUE fetch per leaf transitively
+                # waits on its producing transfer, and overwriting a buffer
+                # mid-transfer would corrupt training data silently
+                for arrays in inflight:
+                    if arrays is None:
+                        continue
+                    for leaf in (
+                        arrays if isinstance(arrays, (tuple, list)) else (arrays,)
+                    ):
+                        np.asarray(leaf[(0,) * leaf.ndim])
+                self._pending[slot] = None
+            leaves = self._leaves(hx, hy)
+            bufs = self._slots[slot]
+            if bufs is None or len(bufs) != len(leaves) or any(
+                b.shape != a.shape or b.dtype != a.dtype
+                for b, a in zip(bufs, leaves)
+            ):
+                # first use, or the tail segment's odd shape: (re)allocate
+                bufs = self._slots[slot] = [np.empty_like(a) for a in leaves]
+            for b, a in zip(bufs, leaves):
+                np.copyto(b, a)
+            self.staging_copies += 1
+            if hy is not None:
+                staged_y = bufs[-1]
+                flat_x = bufs[:-1]
+            else:
+                staged_y = None
+                flat_x = bufs
+            staged_x = (
+                type(hx)(flat_x) if isinstance(hx, (tuple, list)) else flat_x[0]
+            )
+        else:
+            staged_x, staged_y = hx, hy
+        dx = (
+            type(hx)(
+                device_put_stacked(a, self._mesh, self._axis)
+                for a in staged_x
+            )
+            if isinstance(hx, (tuple, list))
+            else device_put_stacked(staged_x, self._mesh, self._axis)
+        )
+        dy = (
+            device_put_stacked(staged_y, self._mesh, self._axis)
+            if staged_y is not None
+            else None
+        )
+        if self.reuse_host_buffers:
+            self._pending[slot] = (dx, dy)
+        return dx, dy
+
+
 def coalesce_segment(features, labels, batch_size: int):
     """Shape one COALESCED host super-batch (``k·B [+tail]`` rows pulled as
     a single slice) into scan-ready stacked arrays: trim to a whole number

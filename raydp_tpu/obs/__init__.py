@@ -30,6 +30,7 @@ from raydp_tpu.obs.metrics import metrics
 from raydp_tpu.obs.tracing import (
     collect,
     current_context,
+    current_sinks,
     enabled,
     flush,
     flush_throttled,
@@ -37,12 +38,14 @@ from raydp_tpu.obs.tracing import (
     set_process_role,
     span,
     use_context,
+    use_sinks,
     with_context,
 )
 
 __all__ = [
     "collect",
     "current_context",
+    "current_sinks",
     "enabled",
     "export_trace",
     "flush",
@@ -54,6 +57,7 @@ __all__ = [
     "set_process_role",
     "span",
     "use_context",
+    "use_sinks",
     "with_context",
 ]
 

@@ -235,6 +235,35 @@ def instant(name: str, **attrs) -> None:
         _buffer_append(record)
 
 
+def current_sinks() -> List[list]:
+    """This thread's active collector sinks — capture them before handing
+    work to a helper thread, and re-install there with ``use_sinks`` so the
+    helper's spans still land in the same query's stats."""
+    return list(_collectors())
+
+
+class use_sinks:
+    """Adopt another thread's collector sinks for a code region (the
+    collector half of cross-THREAD propagation; ``use_context`` is the
+    trace-id half). Appends are GIL-atomic, so two threads sharing a sink
+    list interleave records without corruption."""
+
+    def __init__(self, sinks: List[list]):
+        self._sinks = list(sinks)
+
+    def __enter__(self):
+        _collectors().extend(self._sinks)
+        return self
+
+    def __exit__(self, *exc):
+        got = _collectors()
+        for sink in self._sinks:
+            for i in range(len(got) - 1, -1, -1):
+                if got[i] is sink:
+                    del got[i]
+                    break
+
+
 class collect:
     """Capture every span/instant finished on THIS thread into a list —
     the local-stats consumer (planner query stats, task phase timing).

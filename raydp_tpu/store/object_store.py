@@ -38,8 +38,10 @@ from raydp_tpu.cluster import api as cluster_api
 from raydp_tpu.cluster.common import (
     DRIVER_OWNER,
     ClusterError,
+    object_meta_entry,
     rpc,
     shm_namespace,
+    unlink_block,
 )
 
 # observability: cross-node pulls vs local zero-copy maps (tests assert the
@@ -243,8 +245,7 @@ def _register(ref: ObjectRef, owner: Optional[str], shm_name: Optional[str] = No
             "session dir on the head host"
         )
     ctx = current_context()
-    cluster_api.head_rpc(
-        "object_put",
+    entry = object_meta_entry(
         object_id=ref.object_id,
         owner=owner or current_owner(),
         shm_name=shm_name or ref.shm_name,
@@ -252,6 +253,87 @@ def _register(ref: ObjectRef, owner: Optional[str], shm_name: Optional[str] = No
         node_id=ctx.node_id if ctx else "driver",
         shm_ns=shm_namespace(),
     )
+    staged = getattr(_register_batch_tls, "stack", None)
+    if staged:
+        # a batched_registration() scope is active on this thread: stage the
+        # entry; ONE object_put_batch frame ships everything at scope exit
+        staged[-1].append(entry)
+        return
+    cluster_api.head_rpc("object_put", **entry)
+
+
+# ---------------------------------------------------------------------------
+# batched metadata registration
+# ---------------------------------------------------------------------------
+
+_register_batch_tls = threading.local()
+
+
+def _flush_register_batch(entries: List[dict]) -> None:
+    """Ship staged registrations as one RPC frame; falls back to per-entry
+    puts against an older head that lacks the batch handler."""
+    if not entries:
+        return
+    if len(entries) == 1:
+        cluster_api.head_rpc("object_put", **entries[0])
+        return
+    from raydp_tpu.obs import metrics
+
+    try:
+        cluster_api.head_rpc("object_put_batch", entries=entries)
+        metrics.counter("store.register_batches").inc()
+    except ClusterError as exc:
+        if "unknown head method" not in str(exc):
+            raise
+        for entry in entries:
+            cluster_api.head_rpc("object_put", **entry)
+
+
+def _discard_staged(entries: List[dict]) -> None:
+    """Failure cleanup for a batched-registration scope: some entries MAY
+    have registered (partial per-entry fallback, or a batch frame that
+    applied but whose reply was lost) — head metadata left pointing at
+    locally-unlinked segments would turn later reads into serve failures
+    instead of clean not-found errors. Best-effort delete through the head
+    FIRST (pops metadata and unlinks registered segments), then unlink
+    locally for the never-registered rest."""
+    try:
+        cluster_api.head_rpc(
+            "object_delete", object_ids=[e["object_id"] for e in entries]
+        )
+    except Exception:
+        pass  # head unreachable: metadata dies with the session
+    for entry in entries:
+        unlink_block(entry["shm_name"])
+
+
+class batched_registration:
+    """Defer this thread's block registrations into ONE ``object_put_batch``
+    RPC at scope exit — the metadata side of the shuffle map path (a task
+    batch's blocks register in one frame instead of one RPC each). Scopes
+    nest (each flushes its own entries). On failure — the scope body raising,
+    or the flush itself failing — the staged (never-registered) segments are
+    unlinked, matching ``seal()``'s register-failure cleanup."""
+
+    def __enter__(self) -> "batched_registration":
+        stack = getattr(_register_batch_tls, "stack", None)
+        if stack is None:
+            stack = _register_batch_tls.stack = []
+        self._entries: List[dict] = []
+        stack.append(self._entries)
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        _register_batch_tls.stack.pop()
+        if exc_type is not None:
+            _discard_staged(self._entries)
+            return False
+        try:
+            _flush_register_batch(self._entries)
+        except BaseException:
+            _discard_staged(self._entries)
+            raise
+        return False
 
 
 def new_object_id() -> str:
@@ -556,6 +638,32 @@ def _lookup(ref: ObjectRef) -> dict:
     return meta
 
 
+def lookup_many(refs: Sequence[ObjectRef]) -> dict:
+    """Resolve many refs' metadata in ONE RPC frame: {object_id: meta}.
+    The reduce side of a shuffle resolves every input slice's block through
+    this instead of one ``object_lookup`` round trip per block. Raises (like
+    ``_lookup``) if any object is missing or its owner died; falls back to
+    per-ref lookups against an older head."""
+    ids = list({r.object_id for r in refs})
+    if not ids:
+        return {}
+    try:
+        metas = cluster_api.head_rpc("object_lookup_batch", object_ids=ids)
+    except ClusterError as exc:
+        if "unknown head method" not in str(exc):
+            raise
+        metas = {
+            oid: cluster_api.head_rpc("object_lookup", object_id=oid)
+            for oid in ids
+        }
+    missing = [oid for oid in ids if metas.get(oid) is None]
+    if missing:
+        raise ClusterError(
+            f"object(s) {missing[:3]} not found (already deleted?)"
+        )
+    return metas
+
+
 class _FetchedBuffer:
     """A block pulled over the network from its owning node (no local
     mapping exists for foreign-namespace objects)."""
@@ -596,7 +704,48 @@ class _FileBuffer:
             pass
 
 
-def get_buffer(ref: ObjectRef):
+def _remote_fetch(ref: ObjectRef, meta: dict, offset: int, length: int) -> bytes:
+    """Ranged network pull of ``[offset, offset+length)`` from the owning
+    node's block server (chunked: stays under the wire frame cap for
+    arbitrarily large reads and bounds per-chunk copies). The server's
+    ``block_fetch`` is range-native, so a reducer pulling its slice of an
+    indexed shuffle block moves only that slice's bytes over the network."""
+    chunk = 64 << 20
+    parts = []
+    pulled = 0
+    while pulled < length:
+        part = rpc(
+            meta["fetch_addr"],
+            (
+                "block_fetch",
+                {
+                    "shm_name": meta["shm_name"],
+                    "offset": offset + pulled,
+                    "length": min(chunk, length - pulled),
+                },
+            ),
+            timeout=300,
+        )
+        if not part:
+            break
+        parts.append(part)
+        pulled += len(part)
+    data = parts[0] if len(parts) == 1 else b"".join(parts)
+    stats["remote_fetches"] += 1
+    stats["remote_bytes"] += len(data)
+    from raydp_tpu.obs import metrics
+
+    metrics.counter("store.remote_fetches").inc()
+    metrics.counter("store.remote_bytes").inc(len(data))
+    if len(data) < length:
+        raise ClusterError(
+            f"object {ref.object_id} remote fetch truncated: "
+            f"{len(data)} < {length}"
+        )
+    return data[:length]
+
+
+def get_buffer(ref: ObjectRef, meta: Optional[dict] = None):
     """View of the object's bytes: a zero-copy shm mapping when the object
     lives in THIS node's namespace, otherwise a network pull from the owning
     node's block server (head or node agent) — the cross-host data plane
@@ -604,47 +753,14 @@ def get_buffer(ref: ObjectRef):
     via RayDatasetRDD locality, SURVEY §2.2 S7/S8). Raises OwnerDiedError
     via head if the owner died untransferred. The registered size is
     authoritative — the segment may be 1 byte for empty objects or
-    capacity-sized if finalize was skipped."""
-    meta = _lookup(ref)
+    capacity-sized if finalize was skipped. ``meta`` (from ``lookup_many``)
+    skips the per-object lookup RPC."""
+    if meta is None:
+        meta = _lookup(ref)
     if meta["size"] == 0:
         return _MappedBuffer(_load_native(), 0, 0)
     if meta.get("shm_ns", "") != shm_namespace():
-        # chunked pull: stays under the wire frame cap for arbitrarily large
-        # blocks and bounds per-chunk copies
-        chunk = 64 << 20
-        size = meta["size"]
-        parts = []
-        offset = 0
-        while offset < size:
-            part = rpc(
-                meta["fetch_addr"],
-                (
-                    "block_fetch",
-                    {
-                        "shm_name": meta["shm_name"],
-                        "offset": offset,
-                        "length": min(chunk, size - offset),
-                    },
-                ),
-                timeout=300,
-            )
-            if not part:
-                break
-            parts.append(part)
-            offset += len(part)
-        data = parts[0] if len(parts) == 1 else b"".join(parts)
-        stats["remote_fetches"] += 1
-        stats["remote_bytes"] += len(data)
-        from raydp_tpu.obs import metrics
-
-        metrics.counter("store.remote_fetches").inc()
-        metrics.counter("store.remote_bytes").inc(len(data))
-        if len(data) < size:
-            raise ClusterError(
-                f"object {ref.object_id} remote fetch truncated: "
-                f"{len(data)} < {size}"
-            )
-        return _FetchedBuffer(data[:size])
+        return _FetchedBuffer(_remote_fetch(ref, meta, 0, meta["size"]))
     if meta["shm_name"].startswith("file://"):
         # spilled block on THIS node: mmap the file (still no payload copy)
         path = meta["shm_name"][len("file://"):]
@@ -675,26 +791,63 @@ def get_bytes(ref: ObjectRef) -> bytes:
     return bytes(get_buffer(ref).memoryview())
 
 
-def get_arrow_buffer(ref: ObjectRef):
-    """The object as a pyarrow Buffer backed by the shared mapping
-    (zero-copy) or by fetched bytes (cross-node)."""
+def get_arrow_buffer(
+    ref: ObjectRef,
+    offset: int = 0,
+    length: int = -1,
+    meta: Optional[dict] = None,
+):
+    """The object's bytes — or a ``[offset, offset+length)`` RANGE of them —
+    as a pyarrow Buffer. Local objects stay zero-copy: the range is a window
+    over the shared mapping (shm) or the spill-file mmap; cross-node reads
+    pull ONLY the requested range from the owning node's block server. The
+    range path is the read side of indexed shuffle blocks: a reducer views
+    just its slice of a map task's single output block. ``meta`` (from
+    ``lookup_many``) skips the per-object lookup RPC."""
     import pyarrow as pa
 
-    buf = get_buffer(ref)
-    if buf.size == 0:
+    if meta is None:
+        meta = _lookup(ref)
+    size = meta["size"]
+    if length is None or length < 0:
+        length = size - offset
+    if offset < 0 or length < 0 or offset + length > size:
+        raise ClusterError(
+            f"object {ref.object_id} range [{offset}, {offset + length}) "
+            f"out of bounds for size {size}"
+        )
+    ranged = not (offset == 0 and length == size)
+    if length == 0:
         return pa.py_buffer(b"")
+    if ranged and meta.get("shm_ns", "") != shm_namespace():
+        # ranged network pull: only the slice crosses the wire
+        return pa.py_buffer(_remote_fetch(ref, meta, offset, length))
+    buf = get_buffer(ref, meta=meta)
+    if ranged:
+        from raydp_tpu.obs import metrics
+
+        metrics.counter("store.range_reads").inc()
     if isinstance(buf, (_FetchedBuffer, _FileBuffer)):
         # py_buffer wraps the existing memory (network bytes or spill mmap)
         # without copying; the memoryview inside keeps the backing alive
-        return pa.py_buffer(buf.memoryview())
-    return pa.foreign_buffer(buf.ptr, buf.size, base=buf)
+        view = buf.memoryview()
+        return pa.py_buffer(view[offset : offset + length] if ranged else view)
+    return pa.foreign_buffer(buf.ptr + offset, length, base=buf)
 
 
-def read_arrow_batches(ref: ObjectRef):
-    """Decode an Arrow-IPC-stream object into (schema, [RecordBatch...])."""
+def read_arrow_batches(
+    ref: ObjectRef,
+    offset: int = 0,
+    length: int = -1,
+    meta: Optional[dict] = None,
+):
+    """Decode an Arrow-IPC-stream object (or an IPC-stream RANGE of one —
+    an indexed shuffle block's slice) into (schema, [RecordBatch...])."""
     import pyarrow as pa
 
-    with pa.ipc.open_stream(get_arrow_buffer(ref)) as reader:
+    with pa.ipc.open_stream(
+        get_arrow_buffer(ref, offset, length, meta=meta)
+    ) as reader:
         schema = reader.schema
         batches = list(reader)
     return schema, batches

@@ -132,18 +132,28 @@ def test_last_query_stats_schema(traced_session):
     table = df.to_arrow()
     assert table.num_rows == 200
     stats = traced_session.last_query_stats
-    assert set(stats) == {"seconds", "output_partitions", "stages", "fusion"}
+    assert set(stats) == {
+        "seconds", "output_partitions", "stages", "fusion", "shuffle",
+    }
     assert stats["seconds"] > 0
     assert stats["output_partitions"] >= 1
     assert stats["stages"], "at least one stage must be recorded"
+    assert stats["shuffle"] == []  # narrow-only query: no exchange ran
     for stage in stats["stages"]:
         # per-stage schema: task count, wall seconds, locality + dispatch
         # mode, and the server-side read/compute/emit phase split
-        assert {"tasks", "seconds", "locality_preferred", "dispatch",
+        # (reduce stages dispatched barrier-free report "pipelined" and
+        # carry no locality count — their dispatch happened inside the map
+        # stage's gather loop)
+        assert {"tasks", "seconds", "dispatch",
                 "server_seconds", "read_s", "compute_s", "emit_s"} <= set(
             stage
         ), stage
-        assert stage["dispatch"] in ("per_task", "batched")
+        assert stage["dispatch"] in (
+            "per_task", "batched", "pipelined", "fused", "fused_failed"
+        )
+        if stage["dispatch"] in ("per_task", "batched"):
+            assert "locality_preferred" in stage
         assert stage["tasks"] >= 1
         assert stage["seconds"] >= 0
     # two adjacent Projects fused into one → a recorded fusion decision

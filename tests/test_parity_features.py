@@ -131,7 +131,12 @@ def test_query_stats():
         stats = session.last_query_stats
         assert stats["seconds"] > 0
         assert stats["output_partitions"] >= 1
-        assert len(stats["stages"]) >= 2  # map + reduce
+        if len(stats["stages"]) == 1:
+            # single-executor pools ship the whole map→reduce graph as ONE
+            # fused dispatch — one stage covering both rounds
+            assert stats["stages"][0]["dispatch"] == "fused"
+        else:
+            assert len(stats["stages"]) >= 2  # map + reduce
         assert all(s["tasks"] >= 1 for s in stats["stages"])
     finally:
         raydp_tpu.stop_etl()

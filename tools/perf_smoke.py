@@ -1,0 +1,108 @@
+"""CI perf-smoke: run the NYCTaxi + streaming bench slices on CPU and gate
+gross ETL regressions.
+
+Runs ``bench.py`` with small row counts (CI-sized; override via the usual
+BENCH_* env vars), writes an artifact JSON holding the headline ETL numbers
+plus the full ``etl_breakdown`` and per-exchange shuffle stats, and FAILS
+when:
+
+- ``etl_query_s`` regresses more than 25% over the committed BENCH_r05
+  snapshot's value (the CI slice runs ~10x fewer rows than the snapshot's
+  run, so this is a smoke gate for gross regressions — a structural
+  slowdown in the data plane, not a ±10% noise detector);
+- an indexed shuffle writes more blocks than map tasks (the M-not-M×R
+  invariant of the pipelined shuffle data plane).
+
+Usage: ``python tools/perf_smoke.py [artifact.json]``
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+
+REGRESSION_BUDGET = 0.25  # fail above snapshot * (1 + budget)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def snapshot_etl_query_s() -> float | None:
+    """The committed r05 bench snapshot's NYCTaxi etl_query_s (the snapshot
+    stores the bench stdout tail; first occurrence is the NYCTaxi slice)."""
+    path = os.path.join(REPO, "BENCH_r05.json")
+    try:
+        with open(path) as f:
+            tail = json.load(f).get("tail", "")
+    except (OSError, ValueError):
+        return None
+    found = re.search(r'"etl_query_s": ([0-9.]+)', tail)
+    return float(found.group(1)) if found else None
+
+
+def run_bench() -> dict:
+    env = dict(os.environ)
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    env.setdefault("BENCH_ROWS", "20000")
+    env.setdefault("BENCH_DLRM_ROWS", "10000")
+    env.setdefault("BENCH_SAMPLES", "1")
+    env.setdefault("BENCH_EPOCHS", "4")
+    env.setdefault("BENCH_DLRM_EPOCHS", "4")
+    out = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        capture_output=True, text=True, timeout=1800, env=env, cwd=REPO,
+    )
+    if out.returncode != 0:
+        sys.stderr.write(out.stderr[-2000:])
+        raise SystemExit(f"bench.py failed rc={out.returncode}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def main() -> int:
+    artifact_path = sys.argv[1] if len(sys.argv) > 1 else "perf_smoke.json"
+    result = run_bench()
+    detail = result["detail"]
+    reference = snapshot_etl_query_s()
+    artifact = {
+        "etl_query_s": detail["etl_query_s"],
+        "pandas_etl_s": detail["pandas_etl_s"],
+        "cluster_boot_s": detail["cluster_boot_s"],
+        "streaming_vs_scan": detail["streaming_vs_scan"],
+        "streaming_pipeline": detail.get("streaming_pipeline", {}),
+        "etl_breakdown": detail.get("etl_breakdown", {}),
+        "shuffle_probe": detail.get("shuffle_probe", {}),
+        "reference_etl_query_s": reference,
+        "regression_budget": REGRESSION_BUDGET,
+        "rows": detail.get("rows"),
+    }
+    with open(artifact_path, "w") as f:
+        json.dump(artifact, f, indent=2)
+    print(json.dumps(artifact, indent=2))
+
+    failures = []
+    if reference is not None:
+        limit = reference * (1.0 + REGRESSION_BUDGET)
+        if detail["etl_query_s"] > limit:
+            failures.append(
+                f"etl_query_s {detail['etl_query_s']:.3f}s exceeds "
+                f"{limit:.3f}s (snapshot {reference:.3f}s + "
+                f"{REGRESSION_BUDGET:.0%})"
+            )
+    for entry in artifact["shuffle_probe"].get("shuffle", []):
+        if entry.get("indexed") and entry["blocks"] > entry["map_tasks"]:
+            failures.append(
+                f"indexed shuffle wrote {entry['blocks']} blocks for "
+                f"{entry['map_tasks']} map tasks (expected M, not M×R)"
+            )
+    if failures:
+        for f_ in failures:
+            print(f"PERF-SMOKE FAIL: {f_}", file=sys.stderr)
+        return 1
+    print("PERF-SMOKE OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
