@@ -96,6 +96,8 @@ class NodeAgent:
     def handle_ping(self):
         return "pong"
 
+    # raydp-lint: disable=rpc-protocol (operator introspection surface —
+    # poked ad hoc over the agent socket, no in-tree call site)
     def handle_stats(self):
         with self.lock:
             return dict(self.stats)
@@ -146,9 +148,9 @@ class NodeAgent:
                 except ProcessLookupError:
                     try:
                         os.kill(proc.pid, signal.SIGKILL)
-                    except (ProcessLookupError, PermissionError):
+                    except (ProcessLookupError, PermissionError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead process is idempotent)
                         pass
-                except PermissionError:
+                except PermissionError:  # raydp-lint: disable=swallowed-exceptions (killpg fallback; plain kill already sent)
                     pass
                 return False
             # an OLDER incarnation still running here is by definition stale
@@ -159,7 +161,7 @@ class NodeAgent:
             if old is not None and old.proc.poll() is None:
                 try:
                     os.killpg(old.proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError):
+                except (ProcessLookupError, PermissionError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead process is idempotent)
                     pass
             self.children[spec.actor_id] = _ChildProc(proc, incarnation)
             self.incarnation_floor[spec.actor_id] = incarnation
@@ -183,7 +185,7 @@ class NodeAgent:
         if child is not None and child.proc.poll() is None:
             try:
                 os.killpg(child.proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
+            except (ProcessLookupError, PermissionError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead process is idempotent)
                 pass
         return True
 
@@ -210,7 +212,7 @@ class NodeAgent:
                 if child.proc.poll() is None:
                     try:
                         os.killpg(child.proc.pid, signal.SIGKILL)
-                    except (ProcessLookupError, PermissionError):
+                    except (ProcessLookupError, PermissionError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead process is idempotent)
                         pass
         return True
 
@@ -237,7 +239,12 @@ class NodeAgent:
                     try:
                         start_zygote(self.local_dir)
                     except Exception:
-                        pass  # cold-start fallback keeps working
+                        # cold-start fallback keeps working, but every spawn
+                        # on this node now pays ~450ms of imports — say so
+                        obs_log.warning(
+                            "zygote restart failed; spawns fall back to "
+                            "cold subprocess starts", exc_info=True,
+                        )
             dead = []
             with self.lock:
                 for actor_id, child in list(self.children.items()):
@@ -258,7 +265,7 @@ class NodeAgent:
                         timeout=10,
                     )
                     last_head_ok = time.monotonic()
-                except Exception:
+                except Exception:  # raydp-lint: disable=swallowed-exceptions (death report kept and retried next loop)
                     continue  # keep the entry: retried next loop — a death
                     # report must not be lost to a transient head blip
                 with self.lock:
@@ -294,7 +301,12 @@ class NodeAgent:
                     rpc(self.head_addr, ("ping", {}), timeout=5)
                     last_head_ok = now
                 except Exception:
-                    pass
+                    # expected while the head is briefly unreachable; the
+                    # 15s watchdog below decides — the counter makes flaky
+                    # links visible without log spam
+                    from raydp_tpu.obs import metrics
+
+                    metrics.counter("agent.head_ping_failures").inc()
             if now - last_head_ok > 15.0:
                 # head gone: tear down children and exit (parity: Ray nodes
                 # die with their GCS; prevents orphaned agent processes)
@@ -333,7 +345,7 @@ class NodeAgent:
                     reply = ("err", exc)
                 try:
                     send_frame(self.request, reply)
-                except (ConnectionError, BrokenPipeError):
+                except (ConnectionError, BrokenPipeError):  # raydp-lint: disable=swallowed-exceptions (peer hung up; no one left to reply to)
                     pass
 
         class Server(socketserver.ThreadingTCPServer):
@@ -362,7 +374,10 @@ class NodeAgent:
         try:
             start_zygote(self.local_dir)
         except Exception:
-            pass  # spawns fall back to cold subprocess starts
+            obs_log.warning(
+                "zygote start failed at agent boot; spawns fall back to "
+                "cold subprocess starts", exc_info=True,
+            )
         # publish readiness for whoever launched us
         ready = os.path.join(self.local_dir, "agent_ready.json")
         with open(ready + ".tmp", "w") as f:

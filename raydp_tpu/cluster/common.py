@@ -177,7 +177,7 @@ def resolve_head_addr(session_dir: str) -> str:
                 addr = f.read().strip()
             if addr:
                 return addr
-        except OSError:
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (no tcp addr file: fall through to the unix socket)
             pass
     return sock
 
@@ -267,7 +267,7 @@ def _pool_drop(addr: str) -> None:
         if sock is not None:
             try:
                 sock.close()
-            except OSError:
+            except OSError:  # raydp-lint: disable=swallowed-exceptions (closing a possibly-dead pooled socket)
                 pass
 
 
@@ -443,7 +443,7 @@ def unlink_block(shm_name: str) -> None:
             os.unlink(safe_spill_path(shm_name))
         else:
             os.unlink(os.path.join("/dev/shm", safe_shm_name(shm_name)))
-    except (OSError, ClusterError):
+    except (OSError, ClusterError):  # raydp-lint: disable=swallowed-exceptions (best-effort removal; block may already be gone)
         pass
 
 
@@ -467,7 +467,7 @@ class ZygoteProc:
                 with open(self._log_base + ".exit") as f:
                     self._rc = int(f.read().strip() or 0)
                 return self._rc
-            except (OSError, ValueError):
+            except (OSError, ValueError):  # raydp-lint: disable=swallowed-exceptions (no exit marker yet: pid probe follows)
                 pass  # no marker yet: the child may still be running
         # _probe_pid treats zombies as dead: the child may be dead but not
         # yet reaped by the zygote (its loop cadence stretches under CPU
@@ -520,7 +520,7 @@ def _zygote_source_key() -> str:
             path = os.path.join(dirpath, name)
             try:
                 st = os.stat(path)
-            except OSError:
+            except OSError:  # raydp-lint: disable=swallowed-exceptions (file vanished mid-walk: excluded from the key)
                 continue
             h.update(
                 f"{os.path.relpath(path, pkg_root)}:{st.st_mtime_ns}:{st.st_size};".encode()
@@ -542,7 +542,7 @@ def _probe_pid(pid: int) -> str:
         with open(f"/proc/{pid}/stat") as f:
             if f.read().rsplit(") ", 1)[1][:1] == "Z":
                 return "dead"
-    except (OSError, IndexError):
+    except (OSError, IndexError):  # raydp-lint: disable=swallowed-exceptions (proc entry vanished: next probe decides)
         pass
     return "alive"
 
@@ -576,7 +576,7 @@ def _write_zygote_marker(marker: str, pid: int) -> None:
             os.replace(marker + ".start.tmp", marker + ".start")
         else:
             os.unlink(marker + ".start")
-    except OSError:
+    except OSError:  # raydp-lint: disable=swallowed-exceptions (starttime sidecar is best-effort)
         pass
 
 
@@ -597,7 +597,7 @@ def _marker_pid_alive(marker: str) -> Optional[int]:
         live = _proc_starttime(pid)
         if live is not None and live != recorded:
             return None  # same pid, different process: reuse
-    except (OSError, ValueError):
+    except (OSError, ValueError):  # raydp-lint: disable=swallowed-exceptions (no sidecar (older writer): liveness is the best we have)
         pass  # no sidecar (older writer): plain liveness is the best we have
     return pid
 
@@ -617,6 +617,7 @@ def _adopt_global_zygote(run_dir: str, env: Dict[str, str]) -> bool:
 
     from raydp_tpu.cluster.zygote import (
         GLOBAL_MODE_ENV,
+        touch_adoption_stamp,
         zygote_marker_path,
         zygote_sock_path,
     )
@@ -663,22 +664,18 @@ def _adopt_global_zygote(run_dir: str, env: Dict[str, str]) -> bool:
         sock = zygote_sock_path(run_dir)
         try:
             os.unlink(sock)
-        except OSError:
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (stale symlink may not exist)
             pass
         # symlink may dangle until the global zygote binds — the spawn
         # path's connect-retry loop covers the warm-up window
         os.symlink(zygote_sock_path(gdir), sock)
         _write_zygote_marker(zygote_marker_path(run_dir), pid)
-    # best-effort idle-clock bump: an accepted (empty) connection counts as
-    # activity in the zygote's loop, pushing the TTL a full period out for
-    # the session that just adopted it
-    try:
-        poke = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
-        poke.settimeout(0.2)
-        poke.connect(zygote_sock_path(gdir))
-        poke.close()
-    except OSError:
-        pass  # still warming up: a fresh template is nowhere near its TTL
+        # idle-clock bump UNDER THE LOCK (ADVICE r5): retirement re-checks
+        # this stamp after taking the same flock, so a template exactly at
+        # its idle TTL can no longer retire right after we adopted it — the
+        # old post-unlock socket poke left exactly that window, stranding
+        # the session's marker/symlink on a dead template
+        touch_adoption_stamp(gdir)
     # a dead session-local Popen recorded earlier must not shadow the
     # healthy adopted template in zygote_alive()
     _zygote_procs.pop(run_dir, None)
@@ -701,7 +698,7 @@ def start_zygote(run_dir: str, env: Optional[Dict[str, str]] = None) -> None:
         try:
             if _adopt_global_zygote(run_dir, env_dict):
                 return
-        except Exception:
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (session-local fallback follows)
             pass  # fall back to the session-local template
 
     marker = zygote_marker_path(run_dir)
@@ -804,7 +801,7 @@ def launch_worker(spec, incarnation: int, run_dir: str, env: Dict[str, str]):
     log_base = os.path.join(run_dir, f"a-{spec.actor_id}-{incarnation}")
     try:  # a stale marker from a same-(id, incarnation) relaunch would make
         os.unlink(log_base + ".exit")  # the new child look dead at birth
-    except OSError:
+    except OSError:  # raydp-lint: disable=swallowed-exceptions (stale exit marker may not exist)
         pass
     if getattr(spec, "light", True):
         proc = _zygote_spawn(spec, incarnation, run_dir, env, log_base)

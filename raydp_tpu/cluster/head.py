@@ -124,16 +124,19 @@ class Head:
         # in handle_wait_actor_ready instead of sleep-polling get_actor
         # (polling put ~1.1s of pure sleep on session startup's critical path)
         self.actor_state_cond = threading.Condition(self.lock)
-        self.nodes: Dict[str, NodeRecord] = {}
-        self.node_available: Dict[str, Dict[str, float]] = {}
-        self.actors: Dict[str, _Actor] = {}
-        self.named: Dict[str, str] = {}  # name -> actor_id
-        self.pgs: Dict[str, _PlacementGroup] = {}
-        self.objects: Dict[str, _ObjectMeta] = {}
+        # shared cluster state, mutated by handler threads AND the monitor
+        # loop — every access must hold self.lock (the condition below wraps
+        # the same lock). Machine-checked: tools/analyze guarded-by rule.
+        self.nodes: Dict[str, NodeRecord] = {}  # guarded-by: self.lock|self.actor_state_cond
+        self.node_available: Dict[str, Dict[str, float]] = {}  # guarded-by: self.lock|self.actor_state_cond
+        self.actors: Dict[str, _Actor] = {}  # guarded-by: self.lock|self.actor_state_cond
+        self.named: Dict[str, str] = {}  # name -> actor_id; guarded-by: self.lock|self.actor_state_cond
+        self.pgs: Dict[str, _PlacementGroup] = {}  # guarded-by: self.lock|self.actor_state_cond
+        self.objects: Dict[str, _ObjectMeta] = {}  # guarded-by: self.lock|self.actor_state_cond
         # staged chunks of in-flight proxied puts + per-object last-activity
         # stamps (the TTL sweep in monitor_loop GCs abandoned uploads)
-        self._proxy_staging: Dict[str, Dict[int, bytes]] = {}
-        self._proxy_staging_ts: Dict[str, float] = {}
+        self._proxy_staging: Dict[str, Dict[int, bytes]] = {}  # guarded-by: self.lock|self.actor_state_cond
+        self._proxy_staging_ts: Dict[str, float] = {}  # guarded-by: self.lock|self.actor_state_cond
         self.shutting_down = False
         self._next_ip = 2
         self.tcp_addr: Optional[str] = None  # set by run_head once bound
@@ -154,7 +157,7 @@ class Head:
 
     # ---------- nodes ----------
 
-    def _add_node(
+    def _add_node(  # guarded-by: self.lock|self.actor_state_cond held
         self,
         resources: Dict[str, float],
         node_ip: Optional[str] = None,
@@ -256,7 +259,7 @@ class Head:
         for k, v in req.items():
             avail[k] = avail.get(k, 0.0) + v
 
-    def _alive_nodes(self) -> List[str]:
+    def _alive_nodes(self) -> List[str]:  # guarded-by: self.lock|self.actor_state_cond held
         return [n_id for n_id, n in self.nodes.items() if n.alive]
 
     # ---------- placement groups ----------
@@ -273,12 +276,12 @@ class Head:
             pg = _PlacementGroup(f"pg-{uuid.uuid4().hex[:8]}", bundles, strategy)
             placed: List[tuple] = []  # (bundle, node_id) for rollback
 
-            def place(bundle: _Bundle, node_id: str) -> None:
+            def place(bundle: _Bundle, node_id: str) -> None:  # guarded-by: self.lock|self.actor_state_cond held
                 self._sub(self.node_available[node_id], bundle.resources)
                 bundle.node_id = node_id
                 placed.append((bundle, node_id))
 
-            def rollback() -> None:
+            def rollback() -> None:  # guarded-by: self.lock|self.actor_state_cond held
                 for bundle, node_id in placed:
                     self._add(self.node_available[node_id], bundle.resources)
 
@@ -357,6 +360,8 @@ class Head:
                 for pg_id, pg in self.pgs.items()
             }
 
+    # raydp-lint: disable=rpc-protocol (round-robin bundle cursor: public PG
+    # scheduling surface kept for Ray-parity callers; no in-tree call site)
     def handle_pg_next_bundle(self, pg_id: str) -> int:
         with self.lock:
             pg = self.pgs[pg_id]
@@ -366,7 +371,7 @@ class Head:
 
     # ---------- actors ----------
 
-    def _schedule(self, actor: _Actor) -> str:
+    def _schedule(self, actor: _Actor) -> str:  # guarded-by: self.lock|self.actor_state_cond held
         """Pick a node for the actor and charge resources; raises if nothing fits.
         Records which bundle was charged so death can credit the same bundle."""
         spec = actor.spec
@@ -399,7 +404,7 @@ class Head:
             f"requiring {spec.resources}; available={self.handle_available_resources()}"
         )
 
-    def _spawn(self, actor: _Actor) -> None:
+    def _spawn(self, actor: _Actor) -> None:  # guarded-by: self.lock|self.actor_state_cond held
         spec = actor.spec
         node = self.nodes[actor.node_id]
         if node.agent_addr is not None:
@@ -467,7 +472,7 @@ class Head:
                                 ),
                                 timeout=3,
                             )
-                        except Exception:
+                        except Exception:  # raydp-lint: disable=swallowed-exceptions (best-effort kill of a spawn that lost the incarnation race)
                             pass
 
             threading.Thread(target=_remote_spawn, daemon=True).start()
@@ -611,11 +616,11 @@ class Head:
                 actor.intentional_exit = True
             return True
 
-    def _kill_proc(self, actor: _Actor) -> None:
+    def _kill_proc(self, actor: _Actor) -> None:  # guarded-by: self.lock|self.actor_state_cond held
         if actor.proc is not None and actor.proc.poll() is None:
             try:
                 os.killpg(actor.proc.pid, signal.SIGKILL)
-            except (ProcessLookupError, PermissionError):
+            except (ProcessLookupError, PermissionError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead process is idempotent)
                 pass
             return
         if actor.proc is None and actor.node_id:
@@ -631,7 +636,7 @@ class Head:
                             ("kill_actor", {"actor_id": actor_id}),
                             timeout=10,
                         )
-                    except Exception:
+                    except Exception:  # raydp-lint: disable=swallowed-exceptions (agent gone: the node is dead anyway)
                         pass  # agent gone: the node is dead anyway
 
                 threading.Thread(target=_remote_kill, daemon=True).start()
@@ -676,7 +681,7 @@ class Head:
                 return
             time.sleep(0.005)
 
-    def _release_actor_resources(self, actor: _Actor) -> None:
+    def _release_actor_resources(self, actor: _Actor) -> None:  # guarded-by: self.lock|self.actor_state_cond held
         spec = actor.spec
         if spec.placement_group is not None:
             pg = self.pgs.get(spec.placement_group)
@@ -687,7 +692,7 @@ class Head:
         if actor.node_id is not None and self.nodes[actor.node_id].alive:
             self._add(self.node_available[actor.node_id], spec.resources)
 
-    def _on_actor_death(self, actor: _Actor) -> None:
+    def _on_actor_death(self, actor: _Actor) -> None:  # guarded-by: self.lock|self.actor_state_cond held
         """Monitor-thread callback when an actor process has exited."""
         self._release_actor_resources(actor)
         old_sock = actor.sock_path
@@ -695,7 +700,7 @@ class Head:
         if old_sock and not old_sock.startswith("tcp://"):
             try:
                 os.unlink(old_sock)
-            except OSError:
+            except OSError:  # raydp-lint: disable=swallowed-exceptions (actor socket may already be unlinked)
                 pass
         if actor.intentional_exit or actor.restarts_used >= actor.spec.max_restarts:
             actor.state = ActorState.DEAD
@@ -773,7 +778,7 @@ class Head:
             self._proxy_staging_ts[object_id] = time.monotonic()
         return True
 
-    def _gc_proxy_staging(self, now: float) -> None:
+    def _gc_proxy_staging(self, now: float) -> None:  # guarded-by: self.lock|self.actor_state_cond held
         """Drop staged proxied-put chunks whose client went silent (lock held)."""
         for object_id in [
             o
@@ -831,7 +836,7 @@ class Head:
             )
         return True
 
-    def _meta_view(self, object_id: str, meta: "_ObjectMeta") -> dict:
+    def _meta_view(self, object_id: str, meta: "_ObjectMeta") -> dict:  # guarded-by: self.lock|self.actor_state_cond held
         """Client-facing lookup record for one object (lock held). Where a
         non-local reader can pull the bytes: the owning node's agent, or the
         head itself for head-node objects (parity: plasma locality +
@@ -938,8 +943,10 @@ class Head:
         forget threads would race the agents' own teardown and leak
         /dev/shm segments."""
         by_agent: Dict[str, List[str]] = {}
+        with self.lock:  # snapshot: the routing loop itself stays off-lock
+            nodes = dict(self.nodes)
         for meta in metas:
-            node = self.nodes.get(meta.node_id)
+            node = nodes.get(meta.node_id)
             if node is not None and node.agent_addr is not None:
                 by_agent.setdefault(node.agent_addr, []).append(meta.shm_name)
             else:
@@ -949,7 +956,12 @@ class Head:
                 try:
                     rpc(addr, ("unlink_shm", {"shm_names": shm_names}), timeout=10)
                 except Exception:
-                    pass  # agent gone: its /dev/shm died with the node
+                    # agent gone: its /dev/shm died with the node — but a
+                    # LIVE node failing unlinks would leak segments, so
+                    # count it (the store.delete_failures lesson)
+                    obs_metrics.counter("head.unlink_shm_failures").inc(
+                        len(shm_names)
+                    )
 
             if wait:
                 _fire()
@@ -981,7 +993,7 @@ class Head:
 
         unlink_block(shm_name)
 
-    def _on_owner_dead(self, owner: str) -> None:
+    def _on_owner_dead(self, owner: str) -> None:  # guarded-by: self.lock|self.actor_state_cond held
         dead = []
         for meta in self.objects.values():
             if meta.owner == owner and not meta.owner_died:
@@ -1065,7 +1077,7 @@ class Head:
         for agent_addr in agents:
             try:
                 rpc(agent_addr, ("stop", {}), timeout=5)
-            except Exception:
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (advisory stop; the agent's head-liveness watchdog exits it)
                 pass  # the agent's own head-liveness watchdog will exit it
         return True
 
@@ -1104,7 +1116,9 @@ class Head:
         try:
             start_zygote(self.session_dir)
         except Exception:
-            pass  # spawns keep falling back to cold subprocess starts
+            # spawns keep falling back to cold subprocess starts (~450ms of
+            # imports each) — log so slow restarts are attributable
+            obs_log.warning("zygote restart failed", exc_info=True)
 
     def agent_watchdog_loop(self) -> None:
         """Agent liveness: agents watch the head, the head watches agents.
@@ -1152,7 +1166,7 @@ class Head:
                 if now - agent_last_ok.get(node_id, now) > 15.0:
                     try:
                         self.handle_remove_node(node_id)
-                    except ClusterError:
+                    except ClusterError:  # raydp-lint: disable=swallowed-exceptions (node already removed by a concurrent path)
                         pass
                     agent_last_ok.pop(node_id, None)
                 else:
@@ -1279,7 +1293,10 @@ def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, flo
         if not zygote_alive(session_dir):
             start_zygote(session_dir)
     except Exception:
-        pass  # spawns fall back to cold subprocess starts
+        obs_log.warning(
+            "zygote start failed at head boot; spawns fall back to cold "
+            "subprocess starts", exc_info=True,
+        )
     head.tcp_addr = f"tcp://{_advertised_ip()}:{tcp_server.server_address[1]}"
     tcp_path = os.path.join(session_dir, HEAD_TCP_FILE)
     with open(tcp_path + ".tmp", "w") as f:

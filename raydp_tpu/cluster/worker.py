@@ -217,7 +217,7 @@ def main() -> None:
     sock_path = actor_sock_path(session_dir, actor_id, incarnation)
     try:
         os.unlink(sock_path)
-    except OSError:
+    except OSError:  # raydp-lint: disable=swallowed-exceptions (stale socket path may not exist)
         pass
     stop_event = threading.Event()
     bound: list = []

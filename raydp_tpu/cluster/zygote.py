@@ -31,6 +31,7 @@ import sys
 
 ZYGOTE_SOCK_FILE = "zygote.sock"
 ZYGOTE_MARKER_FILE = "zygote.pid"
+ZYGOTE_ADOPTION_STAMP_FILE = "adopted.stamp"
 
 _listener: socket.socket | None = None
 
@@ -41,6 +42,37 @@ def zygote_sock_path(run_dir: str) -> str:
 
 def zygote_marker_path(run_dir: str) -> str:
     return os.path.join(run_dir, ZYGOTE_MARKER_FILE)
+
+
+def adoption_stamp_path(run_dir: str) -> str:
+    return os.path.join(run_dir, ZYGOTE_ADOPTION_STAMP_FILE)
+
+
+def touch_adoption_stamp(run_dir: str) -> None:
+    """Record 'a session adopted this template NOW'. Written by
+    ``common._adopt_global_zygote`` while it HOLDS the adoption flock, so
+    retirement (which also takes the flock) observes every adoption that
+    completed before it could acquire the lock — the lock-protected
+    last-adopted stamp ADVICE r5 asked for."""
+    stamp = adoption_stamp_path(run_dir)
+    with open(stamp, "w") as f:
+        f.write(str(os.getpid()))
+    # the mtime IS the datum; writing the pid is purely diagnostic
+
+
+def adoption_recent(run_dir: str, ttl_s: float) -> bool:
+    """Did a session adopt this template within ``ttl_s``? Read under the
+    adoption flock by the retirement path: a fresh stamp vetoes retirement
+    (the stamp is re-checked AFTER taking the lock, closing the window where
+    an adoption landed between the idle-TTL check and the lock acquire)."""
+    import time
+
+    try:
+        age = time.time() - os.stat(adoption_stamp_path(run_dir)).st_mtime
+    except OSError:
+        return False
+    # a negative age (clock step) counts as recent: err towards staying up
+    return age <= ttl_s
 
 
 def _warm_imports() -> None:
@@ -69,7 +101,7 @@ def _warm_imports() -> None:
         import raydp_tpu.etl.executor  # noqa: F401
         import raydp_tpu.etl.tasks  # noqa: F401
         import raydp_tpu.store.object_store  # noqa: F401
-    except Exception:  # pragma: no cover - partial environments
+    except Exception:  # pragma: no cover - partial environments; raydp-lint: disable=swallowed-exceptions (partial environments: children import lazily)
         pass
 
 
@@ -117,7 +149,7 @@ def _become_worker(req: dict, conn: socket.socket) -> None:
         from raydp_tpu.cluster import worker
 
         worker.main()
-    except SystemExit:
+    except SystemExit:  # raydp-lint: disable=swallowed-exceptions (worker.main exits via SystemExit on clean shutdown)
         pass
     except BaseException:  # noqa: BLE001 - last-resort report to the log
         from raydp_tpu.obs import get_logger
@@ -133,8 +165,9 @@ def _become_worker(req: dict, conn: socket.socket) -> None:
 
 def _serve_one(children: dict) -> bool:
     """Accept and serve one fork request; False on accept timeout. An
-    empty connection (the adoption path's idle-clock poke, liveness
-    probes) counts as activity but forks nothing."""
+    empty connection (liveness probes) counts as activity but forks
+    nothing. (Adoptions no longer poke the socket — they write the
+    lock-protected adoption stamp instead, which retirement re-checks.)"""
     from raydp_tpu.cluster.common import recv_frame, send_frame
 
     try:
@@ -160,7 +193,7 @@ def _serve_one(children: dict) -> bool:
     finally:
         try:
             conn.close()
-        except OSError:
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (closing a possibly-closed connection)
             pass
     return True
 
@@ -188,7 +221,7 @@ def main() -> None:
     path = zygote_sock_path(run_dir)
     try:
         os.unlink(path)
-    except OSError:
+    except OSError:  # raydp-lint: disable=swallowed-exceptions (stale socket may not exist)
         pass
     _listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
     _listener.bind(path)
@@ -212,7 +245,7 @@ def main() -> None:
         while True:
             try:
                 pid, status = os.waitpid(-1, os.WNOHANG)
-            except ChildProcessError:
+            except ChildProcessError:  # raydp-lint: disable=swallowed-exceptions (no children left to reap)
                 break
             if pid == 0:
                 break
@@ -223,14 +256,19 @@ def main() -> None:
                     with open(log_base + ".exit.tmp", "w") as f:
                         f.write(str(code))
                     os.replace(log_base + ".exit.tmp", log_base + ".exit")
-                except OSError:
+                except OSError:  # raydp-lint: disable=swallowed-exceptions (marker write best-effort; zombie probe covers the gap)
                     pass
         if global_mode:
             # linger only while useful: exit when idle past the TTL and no
             # children remain to reap (their exit markers must not be lost).
-            # The adoption lock serializes retirement against adoption — a
-            # session that just adopted this template must not watch it
-            # vanish between its liveness check and its first fork.
+            # The adoption lock serializes retirement against adoption, and
+            # the lock-protected adoption stamp closes the residual race
+            # (ADVICE r5): adoption's idle-clock poke used to land AFTER the
+            # flock was released, so a template exactly at its TTL could
+            # take the lock and retire right after a session adopted it —
+            # the stamp is written UNDER the adoption lock and re-checked
+            # here UNDER the same lock, so a just-adopted template always
+            # observes the adoption and stays alive.
             if (
                 not children
                 and _time.monotonic() - last_fork > GLOBAL_IDLE_TTL_S
@@ -239,18 +277,27 @@ def main() -> None:
 
                 try:
                     lock_file = open(os.path.join(run_dir, ".lock"), "w")
-                except OSError:
+                except OSError:  # raydp-lint: disable=swallowed-exceptions (cannot open the lock: retry next round)
                     continue
                 try:
                     fcntl.flock(lock_file, fcntl.LOCK_EX | fcntl.LOCK_NB)
                 except OSError:
                     lock_file.close()
                     continue  # adoption in progress: stay alive this round
+                if adoption_recent(run_dir, GLOBAL_IDLE_TTL_S):
+                    # adopted since our last fork: treat as activity and
+                    # serve a full TTL for the adopting session
+                    fcntl.flock(lock_file, fcntl.LOCK_UN)
+                    lock_file.close()
+                    last_fork = _time.monotonic()
+                    continue
                 marker = zygote_marker_path(run_dir)
-                for stale in (path, marker, marker + ".start"):
+                for stale in (
+                    path, marker, marker + ".start", adoption_stamp_path(run_dir)
+                ):
                     try:  # a marker left behind + pid reuse would make a
                         os.unlink(stale)  # later adoption latch onto an
-                    except OSError:  # unrelated process
+                    except OSError:  # unrelated process; raydp-lint: disable=swallowed-exceptions (retirement cleanup of files that may not exist)
                         pass
                 os._exit(0)  # lock released by process exit
         elif os.getppid() != parent:

@@ -376,7 +376,7 @@ def train_distributed(
     finally:
         try:
             job.run(CleanupFn(job_key))
-        except Exception:
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (distributed cleanup is best-effort; workers GC on exit)
             pass
     booster = NativeBooster(trees, edges, base, objective, lr)
     return booster, history

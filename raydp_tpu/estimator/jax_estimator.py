@@ -85,17 +85,22 @@ def enable_persistent_compilation_cache() -> None:
             os.makedirs(cache_dir, exist_ok=True)
             jax.config.update("jax_compilation_cache_dir", cache_dir)
             jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.1)
-    except Exception:
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (compile cache is an optimization; never fail training over it)
         pass  # cache is an optimization; never fail training over it
 
 
 def partial_jit(donate_argnums=()):
     """jax.jit with optional buffer donation (params/opt_state are dead after
-    each step, so donating them halves their device-memory footprint)."""
-    import jax
+    each step, so donating them halves their device-memory footprint).
+
+    Routed through :func:`raydp_tpu.sanitize.checked_jit`: with
+    ``RAYDP_TPU_SANITIZE=donation`` every dispatch first verifies the donated
+    args don't alias externally-owned host memory (the PR 2 streaming-NaN
+    use-after-free class); disabled (the default) this IS a plain jax.jit."""
+    from raydp_tpu.sanitize import checked_jit
 
     def wrap(fn):
-        return jax.jit(fn, donate_argnums=donate_argnums)
+        return checked_jit(fn, donate_argnums=donate_argnums)
 
     return wrap
 
@@ -659,9 +664,15 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             resume_epoch, resume_step = (
                 resume if isinstance(resume, tuple) else (resume, None)
             )
+            # host-OWNED template copies: on CPU, device_get can return
+            # numpy views aliasing the live jax buffers, and orbax may hand
+            # template leaves back by identity — the restore result must
+            # never share memory with the runtime (the sanitizer registers
+            # restored leaves as externally owned, and a span over live
+            # jax memory would misfire when the allocator recycles it)
             template = {
-                "params": jax.device_get(params),
-                "opt_state": jax.device_get(opt_state),
+                "params": jax.tree.map(np.array, jax.device_get(params)),
+                "opt_state": jax.tree.map(np.array, jax.device_get(opt_state)),
             }
             restored = self._restore_checkpoint(
                 resume_epoch, template, step=resume_step
@@ -994,7 +1005,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         def epoch_body(params, opt_state, xb, yb):
             return _scan_over_batches(step_impl, params, opt_state, xb, yb)
 
-        jitted = jax.jit(epoch_body, donate_argnums=(0, 1) if donate else ())
+        jitted = partial_jit(donate_argnums=(0, 1) if donate else ())(epoch_body)
 
         from raydp_tpu.exchange.jax_io import SegmentUploader, iter_prefetch
 
@@ -1042,7 +1053,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             "estimator.stream.producer_idle_s"
                         ).inc(idle)
                         return True
-                    except queue.Full:
+                    except queue.Full:  # raydp-lint: disable=swallowed-exceptions (bounded-queue backpressure loop)
                         continue
                 return False
 
@@ -1106,7 +1117,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     # leave at least half of HBM for params/activations —
                     # pinning must degrade to streaming, not to device OOM
                     budget = min(budget, limit // 2)
-            except Exception:
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (backend without memory stats: keep the config budget)
                 pass  # backend without memory stats: keep the config budget
             return budget
 
@@ -1146,7 +1157,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 while True:
                     try:
                         seg_q.get_nowait()
-                    except queue.Empty:
+                    except queue.Empty:  # raydp-lint: disable=swallowed-exceptions (queue drain at shutdown)
                         break
                 producer.join(timeout=10)
             return params, opt_state, loss_total, done - start_step
@@ -1360,7 +1371,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     yb = ys[perm].reshape((length, batch_size) + ys.shape[1:])
                     return epoch_body(params, opt_state, xb, yb)
 
-                return jax.jit(seg_gather, donate_argnums=(0, 1) if donate else ())
+                return partial_jit(
+                    donate_argnums=(0, 1) if donate else ()
+                )(seg_gather)
 
             def run_segment(params, opt_state, order, start, length):
                 perm = jnp.asarray(
@@ -1377,7 +1390,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 return compiled[length](params, opt_state, xs_dev, ys_dev, perm)
 
         else:
-            jitted = jax.jit(epoch_body, donate_argnums=(0, 1) if donate else ())
+            jitted = partial_jit(
+                donate_argnums=(0, 1) if donate else ()
+            )(epoch_body)
 
             def run_segment(params, opt_state, order, start, length):
                 sel = order[start * batch_size : (start + length) * batch_size]
@@ -1465,10 +1480,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 if key not in compiled:
                     with _compile_span("fullfit") as cspan:
                         compiled[key] = (
-                            jax.jit(
-                                fullfit_body,
+                            partial_jit(
                                 donate_argnums=(0, 1) if donate else (),
-                            )
+                            )(fullfit_body)
                             .lower(params, opt_state, xs_dev, ys_dev, perms)
                             .compile()
                         )
@@ -1759,8 +1773,26 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         path = self._ckpt_path(epoch, step)
         with ocp.StandardCheckpointer() as ckptr:
             if target is not None:
-                return ckptr.restore(path, target)
-            return ckptr.restore(path)
+                restored = ckptr.restore(path, target)
+            else:
+                restored = ckptr.restore(path)
+        # sanitizer bookkeeping (RAYDP_TPU_SANITIZE=donation, no-op
+        # otherwise): restored leaves are host memory owned by orbax's
+        # restore machinery — on CPU jax a zero-copy staging of them must
+        # never be donated (the PR 2 streaming-NaN class); registering them
+        # here lets checked_jit catch any future staging path that skips
+        # the owned-copy dance in _fit
+        from raydp_tpu.sanitize import donation_check_enabled
+
+        if donation_check_enabled():
+            import jax
+
+            from raydp_tpu.sanitize import note_external_host_buffer
+
+            for leaf in jax.tree_util.tree_leaves(restored):
+                if isinstance(leaf, np.ndarray):
+                    note_external_host_buffer(leaf, tag="orbax restore")
+        return restored
 
     def load_checkpoint(self, epoch: int):
         restored = self._restore_checkpoint(epoch)

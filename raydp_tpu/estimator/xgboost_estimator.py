@@ -187,7 +187,7 @@ class XGBoostEstimator(EstimatorInterface, EtlEstimatorInterface):
                     if tracker is not None:
                         try:
                             tracker.wait_for()
-                        except Exception:
+                        except Exception:  # raydp-lint: disable=swallowed-exceptions (tracker join after workers finished is best-effort)
                             pass
                 self._raw_model = results[0]
                 return self._raw_model

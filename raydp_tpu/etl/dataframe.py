@@ -384,7 +384,11 @@ class DataFrame:
         return self.take(n)
 
     def show(self, n: int = 20) -> None:
-        print(self.limit(n).to_pandas().to_string())
+        # deliberate console output (Spark's DataFrame.show parity), not a
+        # runtime diagnostic
+        print(  # raydp-lint: disable=print-diagnostics (user-facing output)
+            self.limit(n).to_pandas().to_string()
+        )
 
     def describe(self, *cols: str) -> "DataFrame":
         """count/mean/stddev/min/max per numeric column, one row per statistic
@@ -497,7 +501,7 @@ class DataFrame:
         if mode == "info":
             return planner.explain_info(self._plan)
         text = planner.format_explain(self._plan)
-        print(text)
+        print(text)  # raydp-lint: disable=print-diagnostics (user-facing output)
         return text
 
     def write_parquet(self, path: str) -> int:

@@ -80,7 +80,7 @@ class EtlExecutor:
                 store.delete([ref])
 
             self._pool().submit(_store_round_trip).result(timeout=30)
-        except Exception:
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (warm-up is opportunistic; cost returns to the first task)
             pass  # cold-start costs return to the first task, nothing else
 
     def ping(self) -> int:

@@ -221,7 +221,9 @@ class Planner:
             try:
                 hook(len(specs))
             except Exception:
-                pass  # allocation policy failures must never fail the query
+                # allocation policy failures must never fail the query —
+                # but a broken policy should show up somewhere
+                obs.metrics.counter("etl.scale_hook_failures").inc()
         batched = False
         stage_span = obs.span("etl.stage", tasks=len(specs))
         stage_span.__enter__()
@@ -273,7 +275,7 @@ class Planner:
                     ),
                     emit_s=round(sum(r.emit_seconds for r in results), 6),
                 )
-            except (NameError, AttributeError):
+            except (NameError, AttributeError):  # raydp-lint: disable=swallowed-exceptions (dispatch raised before results existed)
                 pass  # dispatch raised before results existed
             stage_span.__exit__(None, None, None)
 
@@ -405,7 +407,7 @@ class Planner:
             try:
                 hook(len(specs))
             except Exception:
-                pass
+                obs.metrics.counter("etl.scale_hook_failures").inc()
         stage_span = obs.span("etl.stage", tasks=len(specs))
         stage_span.__enter__()
         try:
@@ -434,7 +436,7 @@ class Planner:
                     ),
                     emit_s=round(sum(r.emit_seconds for r in results), 6),
                 )
-            except (NameError, AttributeError):
+            except (NameError, AttributeError):  # raydp-lint: disable=swallowed-exceptions (dispatch raised before results existed)
                 pass  # dispatch raised before results existed
             stage_span.__exit__(None, None, None)
 
@@ -452,7 +454,7 @@ class Planner:
         result = self._empty_result_uncached(node)
         try:
             node._cached_empty = result  # type: ignore[attr-defined]
-        except AttributeError:
+        except AttributeError:  # raydp-lint: disable=swallowed-exceptions (slotted plan nodes cannot cache; recompute is correct)
             pass
         return result
 
@@ -606,7 +608,7 @@ class Planner:
                         merge_projects(fused[-1].columns, node.columns),
                     )
                     continue
-                except CannotSubstitute:
+                except CannotSubstitute:  # raydp-lint: disable=swallowed-exceptions (user-defined Expr subclass: keep the step separate)
                     pass  # user-defined Expr subclass: keep the step separate
             fused.append(node)
         return fused
@@ -1016,7 +1018,10 @@ class Planner:
             try:
                 hook(len(map_specs))
             except Exception:
-                pass
+                # local import: this function's `obs` binding happens below
+                from raydp_tpu.obs import metrics
+
+                metrics.counter("etl.scale_hook_failures").inc()
             if len(self.executors) != 1:
                 return None
         from raydp_tpu import obs
@@ -1375,7 +1380,14 @@ class Planner:
             if samples
             else pa.table({key: pa.array([], child.schema.field(key).type)})
         )
-        values = np.sort(merged.column(key).to_numpy(zero_copy_only=False))
+        # nulls-last sampling: boundaries come from NON-null samples only —
+        # np.sort on an object array containing None raises (the seed-era
+        # sort() crash on null-bearing string keys), and null rows are
+        # range-routed to the last partition regardless (see _range_indices),
+        # matching the nulls-last merge placement below
+        values = np.sort(
+            merged.column(key).drop_null().to_numpy(zero_copy_only=False)
+        )
         if len(values) == 0 or n == 1:
             boundaries = pa.table({key: pa.array([], child.schema.field(key).type)})
         else:

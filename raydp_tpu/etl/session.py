@@ -352,7 +352,7 @@ class EtlSession:
         if desired > len(self.executors):
             try:
                 self.request_total_executors(desired)
-            except ClusterError:
+            except ClusterError:  # raydp-lint: disable=swallowed-exceptions (no capacity: the stage runs on the current pool)
                 pass  # no capacity: the stage runs on the current pool
 
     def _dealloc_loop(self) -> None:
@@ -374,7 +374,11 @@ class EtlSession:
                         min_keep=self._dyn_min,
                     )
                 except Exception:
-                    pass
+                    # idle-scale-down is opportunistic, but a persistently
+                    # failing one pins the pool at max size — count it
+                    from raydp_tpu.obs import metrics
+
+                    metrics.counter("etl.dynamic_scale_failures").inc()
 
     def request_total_executors(self, total: int) -> int:
         """Scale the executor pool up to ``total`` (no-op when already at or
@@ -453,12 +457,12 @@ class EtlSession:
                     old_owner=handle._actor_id,
                     new_owner=self.master._actor_id,
                 )
-            except Exception:
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death; reown is best-effort)
                 pass  # older head / racing shutdown: blocks fall back to GC
         for handle in victims:
             try:
                 handle.kill(no_restart=True)
-            except Exception:
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death)
                 pass
         deadline = time.monotonic() + 15.0
         for handle in victims:
@@ -466,7 +470,7 @@ class EtlSession:
                 try:
                     if handle.state() == ActorState.DEAD:
                         break
-                except Exception:
+                except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death)
                     break
                 time.sleep(0.05)
         self._planner.executors = list(self.executors)
@@ -495,7 +499,7 @@ class EtlSession:
         for handle in killed:
             try:
                 handle.kill(no_restart=True)
-            except Exception:
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death)
                 pass
         self.executors = []
         # drain: wait for the head to reap the executors so their resources
@@ -508,18 +512,18 @@ class EtlSession:
 
                     if handle.state() == ActorState.DEAD:
                         break
-                except Exception:
+                except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death)
                     break
                 time.sleep(0.002)  # the head reaps intentional kills in ~ms
         if cleanup_data and del_obj_holder:
             try:
                 self.master.kill(no_restart=True)
-            except Exception:
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death)
                 pass
         if self._owns_pg and self._pg is not None:
             try:
                 cluster.remove_placement_group(self._pg)
-            except Exception:
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races placement-group removal)
                 pass
             self._pg = None
         with _lock:

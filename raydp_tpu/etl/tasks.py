@@ -884,14 +884,25 @@ def _range_indices(
     table: pa.Table, keys: List[str], boundaries: pa.Table, ascending: List[bool]
 ) -> np.ndarray:
     """Assign each row to a range partition via searchsorted on the first key
-    (boundaries were sampled on the same basis)."""
+    (boundaries were sampled on the same basis, nulls excluded).
+
+    Null keys sort LAST in either direction (matching the merge step's
+    ``null_placement="at_end"``), so null rows route to the LAST partition —
+    on object arrays searchsorted would raise comparing None, and on floats
+    NaN's ordering was direction-dependent garbage before this."""
     key = keys[0]
-    values = table.column(key).combine_chunks().to_numpy(zero_copy_only=False)
+    column = table.column(key).combine_chunks()
+    null_mask = column.is_null().to_numpy(zero_copy_only=False)
+    values = column.to_numpy(zero_copy_only=False)
     bounds = boundaries.column(key).to_numpy(zero_copy_only=False)
-    idx = np.searchsorted(bounds, values, side="right")
-    if not ascending[0]:
-        idx = len(bounds) - idx
-    return idx.astype(np.int64)
+    idx = np.full(len(values), len(bounds), dtype=np.int64)  # nulls → last
+    valid = ~null_mask
+    if valid.any():
+        pos = np.searchsorted(bounds, values[valid], side="right")
+        if not ascending[0]:
+            pos = len(bounds) - pos
+        idx[valid] = pos
+    return idx
 
 
 def _split_table(table: pa.Table, indices: np.ndarray, num_splits: int) -> List[pa.Table]:
@@ -935,11 +946,15 @@ def _read_and_merge(spec: TaskSpec) -> pa.Table:
     if spec.merge.kind == "final_agg":
         table = final_agg(table, spec.merge.keys, spec.merge.aggs)
     elif spec.merge.kind == "sort":
+        # nulls sort LAST in either direction — explicit so the within-
+        # partition order provably matches the range router's nulls-to-last-
+        # partition placement (global order would silently break otherwise)
         table = table.sort_by(
             [
                 (k, "ascending" if asc else "descending")
                 for k, asc in zip(spec.merge.keys, spec.merge.ascending)
-            ]
+            ],
+            null_placement="at_end",
         )
     elif spec.merge.kind == "distinct":
         table = table.group_by(

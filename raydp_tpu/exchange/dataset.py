@@ -652,7 +652,7 @@ class StreamingBatchIterator:
             # unblock a producer waiting on a full queue
             try:
                 staged.get_nowait()
-            except Exception:
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (queue drain at close)
                 pass
 
 

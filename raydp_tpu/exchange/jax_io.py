@@ -180,7 +180,7 @@ def iter_prefetch(it: Iterator, depth: int = 1) -> Iterator:
                     try:
                         q.put(item, timeout=0.2)
                         break
-                    except queue.Full:
+                    except queue.Full:  # raydp-lint: disable=swallowed-exceptions (bounded-queue backpressure loop)
                         continue
                 if stop.is_set():
                     return
@@ -202,7 +202,7 @@ def iter_prefetch(it: Iterator, depth: int = 1) -> Iterator:
         stop.set()
         try:
             q.get_nowait()  # unblock a worker parked on the full queue
-        except Exception:
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (drain to unblock the parked producer)
             pass
 
 
@@ -250,6 +250,20 @@ class SegmentUploader:
         returns (device_x, device_y) shaped like the inputs."""
         import jax
 
+        from raydp_tpu.sanitize import donation_check_enabled
+
+        if donation_check_enabled():
+            # sanitizer bookkeeping: both the caller's decode buffers (Arrow
+            # view chains) and our reusable staging slots are host memory the
+            # jax runtime does not own — if a downstream jit ever donates a
+            # zero-copy staging of them, checked_jit must catch it (the PR 2
+            # hazard class this class's CPU auto-disable dodges)
+            from raydp_tpu.sanitize import note_external_host_buffer
+
+            for leaf in self._leaves(hx, hy):
+                if leaf is not None:
+                    note_external_host_buffer(leaf, tag="segment upload buffer")
+
         if self.reuse_host_buffers:
             slot = self._next % self._depth
             self._next += 1
@@ -280,6 +294,17 @@ class SegmentUploader:
             ):
                 # first use, or the tail segment's odd shape: (re)allocate
                 bufs = self._slots[slot] = [np.empty_like(a) for a in leaves]
+                from raydp_tpu.sanitize import (
+                    donation_check_enabled,
+                    note_external_host_buffer,
+                )
+
+                if donation_check_enabled():
+                    # the reusable slots are overwritten every `depth`
+                    # segments — a donated zero-copy alias of one would be
+                    # the PR 3 hazard in its worst form
+                    for b in bufs:
+                        note_external_host_buffer(b, tag="staging slot")
             for b, a in zip(bufs, leaves):
                 np.copyto(b, a)
             self.staging_copies += 1

@@ -35,7 +35,7 @@ def _gather(drain: bool = True) -> Dict[str, Any]:
             dump = cluster_api.head_rpc("obs_dump", timeout=30.0)
             spans.extend(dump.get("spans", []))
             proc_metrics.update(dump.get("metrics", {}))
-    except Exception:
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (no cluster (or dead head): local-only export below)
         pass  # no cluster (or a dead head): local-only export below
     if drain:
         spans.extend(drain_local())  # anything the flush could not ship

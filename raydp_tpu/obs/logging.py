@@ -43,7 +43,7 @@ class StructuredLogger:
         try:
             sys.stderr.write(line + "\n")
             sys.stderr.flush()
-        except (OSError, ValueError):
+        except (OSError, ValueError):  # raydp-lint: disable=swallowed-exceptions (a closed stderr at teardown must never raise)
             pass  # a closed stderr at teardown must never raise
 
     def info(self, message: str, exc_info: bool = False, **fields) -> None:

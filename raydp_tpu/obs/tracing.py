@@ -403,5 +403,5 @@ def _flush_at_exit() -> None:  # pragma: no cover - exit path
     if _enabled:
         try:
             flush()
-        except Exception:
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (atexit flush: the logging plane may already be gone)
             pass

@@ -91,7 +91,7 @@ def _active_mesh():
         physical = thread_resources.env.physical_mesh
         if not physical.empty:
             return physical
-    except Exception:
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (optional fast path; caller falls back)
         pass
     return None
 

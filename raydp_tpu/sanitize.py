@@ -1,0 +1,261 @@
+"""Runtime donation-aliasing sanitizer (``RAYDP_TPU_SANITIZE=donation``).
+
+The ASan/TSan-style counterpart of the static ``donation-aliasing`` lint
+rule (tools/analyze): on CPU jax, ``jax.device_put``/``jnp.asarray``
+zero-copy suitably-aligned numpy arrays, so a device array staged from an
+externally-owned host buffer (orbax restore results, Arrow ``to_numpy``
+views, reusable staging buffers) ALIASES memory jax does not own. Donating
+such an array (``donate_argnums``) lets XLA scribble over it — the PR 2
+"streaming NaN" use-after-free, which corrupted restored params silently and
+took 8 repro rounds on a 2-core box to pin down.
+
+The sanitizer turns that silent corruption into an immediate, attributable
+error:
+
+- staging sites register the host buffers they do not own
+  (:func:`note_external_host_buffer` — wired into the estimator's checkpoint
+  restore and ``jax_io.SegmentUploader``);
+- :func:`checked_jit` wraps ``jax.jit`` and, before each dispatch, verifies
+  no donated argument's device buffer overlaps a registered external range
+  (``unsafe_buffer_pointer`` per addressable shard vs the registered
+  ``__array_interface__`` spans), raising :class:`DonationAliasError`
+  instead of corrupting params.
+
+Default OFF: with the env var unset, registration is a no-op and the
+per-dispatch check short-circuits on its first comparison (the env is read
+at DISPATCH time, so a jit built before the var was set is still covered
+once it is). Tier-1 tests enable it (tests/conftest.py), so any future
+staging path that re-introduces the hazard fails loudly in CI rather than
+as a flake. Registered ranges are dropped
+automatically when the registering array is garbage collected (a freed range
+must not indict an unrelated later allocation at the same address).
+"""
+
+from __future__ import annotations
+
+import os
+import weakref
+from typing import Any, Dict, Optional, Tuple
+
+__all__ = [
+    "DonationAliasError",
+    "donation_check_enabled",
+    "note_external_host_buffer",
+    "checked_jit",
+    "guard_donated_args",
+    "external_range_count",
+]
+
+
+class DonationAliasError(RuntimeError):
+    """A donated jit argument aliases externally-owned host memory."""
+
+
+def donation_check_enabled() -> bool:
+    """Read the env each call: tests toggle it; the per-dispatch cost is one
+    getenv + substring test, and only when a donated jit actually fires."""
+    return "donation" in os.environ.get("RAYDP_TPU_SANITIZE", "")
+
+
+# address-keyed registry of externally-owned host spans: id(base) ->
+# (start, end, tag, finalizer). Keyed by the registering object's id with a
+# weakref finalizer so a collected buffer frees its span — a stale span would
+# indict whatever unrelated allocation lands at that address next.
+_external: Dict[int, Tuple[int, int, str]] = {}
+_finalizers: Dict[int, Any] = {}
+
+
+def _ultimate_base(arr) -> Any:
+    """Walk the numpy view chain to the owning object — registering the base
+    covers every view sliced out of it."""
+    seen = 0
+    base = arr
+    while getattr(base, "base", None) is not None and seen < 64:
+        base = base.base
+        seen += 1
+    return base
+
+
+def _host_span(arr) -> Optional[Tuple[int, int]]:
+    iface = getattr(arr, "__array_interface__", None)
+    if not iface:
+        return None
+    start = iface.get("data", (None,))[0]
+    nbytes = getattr(arr, "nbytes", 0)
+    if start is None or not nbytes:
+        return None
+    return (start, start + nbytes)
+
+
+def note_external_host_buffer(arr, tag: str = "external") -> None:
+    """Register ``arr`` (a numpy array or view) as externally-owned host
+    memory. No-op unless the donation sanitizer is enabled.
+
+    The registered span is the ultimate base buffer when it is itself an
+    ndarray (covering sibling views), else the view's own bytes. The span's
+    LIFETIME is tied to ``arr`` — an ndarray is always weakref-able, while a
+    view's true owner often is not (orbax leaves sit on ``bytes``), and a
+    span that outlives its memory would indict whatever jax allocation lands
+    at that address next (observed as a flaky false positive on the
+    estimator retry test before this was lifetime-scoped)."""
+    if not donation_check_enabled():
+        return
+    import numpy as np
+
+    if not isinstance(arr, np.ndarray):
+        arr = getattr(arr, "__array__", lambda: None)()
+        if arr is None:
+            return
+    base = _ultimate_base(arr)
+    span = _host_span(base if isinstance(base, np.ndarray) else arr)
+    if span is None:
+        return
+    key = id(arr)
+    if key in _external:
+        return
+    _external[key] = (span[0], span[1], tag)
+    _finalizers[key] = weakref.finalize(arr, _drop_external, key)
+
+
+def _drop_external(key: int) -> None:
+    _external.pop(key, None)
+    _finalizers.pop(key, None)
+
+
+def external_range_count() -> int:
+    return len(_external)
+
+
+def _overlapping_tag(start: int, end: int) -> Optional[str]:
+    for s, e, tag in _external.values():
+        if start < e and s < end:
+            return tag
+    return None
+
+
+def _leaf_device_spans(leaf):
+    """(start, end) spans of a donated leaf's host-visible buffers. Only CPU
+    jax can alias host numpy memory; other backends yield nothing."""
+    import numpy as np
+
+    if isinstance(leaf, np.ndarray):
+        # same base-else-view fallback as registration: a view whose owner
+        # is not an ndarray (bytes-backed orbax leaves) must still yield its
+        # own span, or donating that exact registered array goes unchecked
+        base = _ultimate_base(leaf)
+        span = _host_span(base if isinstance(base, np.ndarray) else leaf)
+        if span is not None:
+            yield span
+        return
+    shards = getattr(leaf, "addressable_shards", None)
+    if shards is None:
+        return
+    for shard in shards:
+        data = getattr(shard, "data", None)
+        try:
+            ptr = data.unsafe_buffer_pointer()
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (deleted/donated/remote buffer: nothing to check)
+            continue  # deleted/donated/remote buffer: nothing to check
+        yield (ptr, ptr + getattr(data, "nbytes", 0))
+
+
+def guard_donated_args(donated_leaves, label: str = "jit") -> None:
+    """Raise :class:`DonationAliasError` if any leaf of the donated
+    arguments overlaps a registered externally-owned host span."""
+    if not _external:
+        return
+    import jax
+
+    if jax.default_backend() != "cpu":
+        return  # zero-copy host aliasing is a CPU-backend hazard
+    for leaf in donated_leaves:
+        for start, end in _leaf_device_spans(leaf):
+            tag = _overlapping_tag(start, end)
+            if tag is not None:
+                shape = getattr(leaf, "shape", "?")
+                dtype = getattr(leaf, "dtype", "?")
+                raise DonationAliasError(
+                    f"donated argument of {label} (leaf shape={shape} "
+                    f"dtype={dtype}) aliases externally-owned "
+                    f"host memory ({tag}, span 0x{start:x}-0x{end:x}): on CPU "
+                    "jax, device_put/jnp.asarray zero-copy host numpy "
+                    "buffers, and donating the alias lets XLA reuse memory "
+                    "it does not own (the PR 2 streaming-NaN class). Stage "
+                    "through an owned copy first: "
+                    "jnp.array(device_put(x, sharding), copy=True)."
+                )
+
+
+def _check_args(donated: Tuple[int, ...], name: str, args) -> None:
+    if not _external or not donation_check_enabled():
+        return
+    import jax
+
+    leaves = []
+    for i in donated:
+        if i < len(args):
+            leaves.extend(jax.tree_util.tree_leaves(args[i]))
+    guard_donated_args(leaves, label=name)
+
+
+class _CheckedCompiled:
+    """AOT executable (``jit(...).lower(...).compile()``) with the same
+    pre-dispatch check — the scan/stream runners dispatch through compiled
+    executables, not the jit wrapper, and must not dodge the sanitizer."""
+
+    def __init__(self, compiled, donated: Tuple[int, ...], name: str):
+        self._compiled = compiled
+        self._donated = donated
+        self._name = name
+
+    def __call__(self, *args, **kwargs):
+        _check_args(self._donated, self._name, args)
+        return self._compiled(*args, **kwargs)
+
+    def __getattr__(self, attr):
+        return getattr(self._compiled, attr)
+
+
+class _CheckedLowered:
+    def __init__(self, lowered, donated: Tuple[int, ...], name: str):
+        self._lowered = lowered
+        self._donated = donated
+        self._name = name
+
+    def compile(self, *args, **kwargs):
+        return _CheckedCompiled(
+            self._lowered.compile(*args, **kwargs), self._donated, self._name
+        )
+
+    def __getattr__(self, attr):
+        return getattr(self._lowered, attr)
+
+
+def checked_jit(fn, donate_argnums=(), label: Optional[str] = None, **jit_kwargs):
+    """``jax.jit`` plus the pre-dispatch donation-aliasing check.
+
+    With nothing donated this IS ``jax.jit(fn, ...)``. Donating jits get a
+    thin wrapper whose check short-circuits per call on "no registered
+    spans / sanitizer disabled" — the env is read at DISPATCH time (not
+    baked in at build), so a jit built before ``RAYDP_TPU_SANITIZE`` was
+    set is still covered. The check also rides through the AOT chain
+    (``.lower(...).compile()(...)``)."""
+    import jax
+
+    jitted = jax.jit(fn, donate_argnums=donate_argnums, **jit_kwargs)
+    if isinstance(donate_argnums, int):
+        donate_argnums = (donate_argnums,)
+    if not donate_argnums:
+        return jitted
+    name = label or getattr(fn, "__name__", "jit")
+    donated = tuple(donate_argnums)
+
+    def checked(*args, **kwargs):
+        _check_args(donated, name, args)
+        return jitted(*args, **kwargs)
+
+    checked.__wrapped__ = jitted  # tests/debuggers can reach the raw jit
+    checked.lower = lambda *a, **kw: _CheckedLowered(
+        jitted.lower(*a, **kw), donated, name
+    )
+    return checked

@@ -74,7 +74,13 @@ class SpmdWorker:
             try:
                 jax.config.update("jax_platforms", "cpu")
             except Exception:
-                pass
+                from raydp_tpu.obs import log as obs_log
+
+                obs_log.warning(
+                    "could not force jax_platforms=cpu; the rank may "
+                    "initialize against the image's default backend",
+                    exc_info=True,
+                )
         jax.distributed.initialize(
             coordinator_address=coordinator_address,
             num_processes=num_processes,
@@ -325,7 +331,7 @@ class SpmdJob:
                     i, value, err = drain_q.get(
                         timeout=0.2 if block else 0.0
                     )
-                except queue.Empty:
+                except queue.Empty:  # raydp-lint: disable=swallowed-exceptions (queue drain)
                     break
                 block = False
                 draining.discard(i)
@@ -353,7 +359,11 @@ class SpmdJob:
                 try:
                     w.kill(no_restart=True)
                 except Exception:
-                    pass
+                    # already dead is the common case; count the rest so a
+                    # systematically failing teardown is visible in metrics
+                    from raydp_tpu.obs import metrics
+
+                    metrics.counter("spmd.teardown_kill_failures").inc()
             self._workers = []
             # drain: bundles must be free before the PG is removed, and the
             # next job's PG must see the resources back
@@ -363,14 +373,20 @@ class SpmdJob:
                     try:
                         if w.state() == ActorState.DEAD:
                             break
-                    except Exception:
+                    except Exception:  # raydp-lint: disable=swallowed-exceptions (polling a dying actor)
                         break
                     time.sleep(0.05)
             if self._owns_pg and self._pg is not None:
                 try:
                     cluster.remove_placement_group(self._pg)
                 except Exception:
-                    pass
+                    from raydp_tpu.obs import log as obs_log
+
+                    obs_log.warning(
+                        "failed to remove SPMD placement group; bundles may "
+                        "stay reserved until session shutdown",
+                        pg=self._pg.id, exc_info=True,
+                    )
                 self._pg = None
                 self._owns_pg = False
             self._started = False

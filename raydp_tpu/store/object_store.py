@@ -51,7 +51,7 @@ stats = {"remote_fetches": 0, "remote_bytes": 0}
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libraydp_store.so")
 _lib_lock = threading.Lock()
-_lib: Optional[ctypes.CDLL] = None
+_lib: Optional[ctypes.CDLL] = None  # guarded-by: _lib_lock
 
 
 def _load_native() -> ctypes.CDLL:
@@ -157,7 +157,7 @@ class _MappedBuffer:
         try:
             if self.ptr:
                 self._lib.rtpu_shm_unmap(ctypes.c_void_p(self.ptr), self.mapped_size)
-        except Exception:
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (__del__ teardown must never raise)
             pass
 
 
@@ -195,7 +195,7 @@ class WritableBlock:
     def _close_mapping(self) -> None:
         try:
             self._mmap.close()
-        except BufferError:
+        except BufferError:  # raydp-lint: disable=swallowed-exceptions (an arrow sink still holds the buffer; kernel keeps the pages)
             pass  # an arrow sink still holds the buffer; kernel keeps the pages
         self._file.close()
 
@@ -302,7 +302,11 @@ def _discard_staged(entries: List[dict]) -> None:
             "object_delete", object_ids=[e["object_id"] for e in entries]
         )
     except Exception:
-        pass  # head unreachable: metadata dies with the session
+        # head unreachable: metadata dies with the session — counted like
+        # _delete_blocks failures so quiet leaks stay visible
+        from raydp_tpu.obs import metrics
+
+        metrics.counter("store.delete_failures").inc(len(entries))
     for entry in entries:
         unlink_block(entry["shm_name"])
 
@@ -409,7 +413,7 @@ class _SpillBlock:
     def _close_mapping(self) -> None:
         try:
             self._mmap.close()
-        except BufferError:
+        except BufferError:  # raydp-lint: disable=swallowed-exceptions (an arrow sink still holds the buffer)
             pass
         self._file.close()
 
@@ -426,7 +430,7 @@ class _SpillBlock:
         except BaseException:
             try:
                 os.unlink(self.path)
-            except OSError:
+            except OSError:  # raydp-lint: disable=swallowed-exceptions (spill file may already be gone)
                 pass
             self._sealed = True
             raise
@@ -438,7 +442,7 @@ class _SpillBlock:
             self._close_mapping()
             try:
                 os.unlink(self.path)
-            except OSError:
+            except OSError:  # raydp-lint: disable=swallowed-exceptions (spill file may already be gone)
                 pass
             self._sealed = True
 
@@ -490,7 +494,7 @@ def _proxy_put(
             cluster_api.head_rpc(
                 "object_put_proxy_abort", object_id=object_id, timeout=5.0
             )
-        except Exception:
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (abort rpc is best-effort; the TTL sweep GCs the staging)
             pass
         raise
 
@@ -625,7 +629,7 @@ def _put_spill(object_id: str, buf, owner: Optional[str]) -> ObjectRef:
     except BaseException:
         try:
             os.unlink(path)
-        except OSError:
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (cleanup of a failed spill write)
             pass
         raise
     return ref
@@ -700,7 +704,7 @@ class _FileBuffer:
             if self._mmap is not None:
                 self._mmap.close()
             self._file.close()
-        except Exception:
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (close teardown must never raise)
             pass
 
 

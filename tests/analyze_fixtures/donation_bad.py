@@ -1,0 +1,28 @@
+"""Seeded violation: the PR 2 resume-staging hazard, pre-fix shape."""
+import jax
+import jax.numpy as jnp
+
+
+def partial_jit(donate_argnums=()):
+    def wrap(fn):
+        return jax.jit(fn, donate_argnums=donate_argnums)
+
+    return wrap
+
+
+class Estimator:
+    def _restore_checkpoint(self, epoch):
+        raise NotImplementedError
+
+    def fit(self, params, opt_state, step_impl, donate_state):
+        donate = (0, 1) if donate_state else ()
+        train_step = partial_jit(donate_argnums=donate)(step_impl)
+        restored = self._restore_checkpoint(3)
+        # BUG: zero-copy staging of orbax-owned host buffers, then donated
+        params = jax.tree.map(
+            lambda x: jax.device_put(x), restored["params"]
+        )
+        opt_state = jnp.asarray(restored["opt_state"])
+        for _ in range(3):
+            params, opt_state = train_step(params, opt_state)
+        return params

@@ -1,0 +1,37 @@
+"""Seeded violation: guarded attribute touched outside its lock (the
+_reap_after_kill double-read class)."""
+import threading
+
+_cache_lock = threading.Lock()
+_cache = None  # guarded-by: _cache_lock
+
+
+class Loader:
+    """No guarded attrs of its own — guarded GLOBALS must still be checked
+    inside its methods."""
+
+    def peek(self):
+        return _cache  # BUG: global read outside _cache_lock
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.actors = {}  # guarded-by: self._lock
+
+    def ok(self, key):
+        with self._lock:
+            return self.actors.get(key)
+
+    def racy(self, key):
+        if self.actors.get(key) is None:  # BUG: read outside the lock
+            return None
+        with self._lock:
+            return self.actors[key]
+
+    def racy_closure(self):
+        def later():
+            return len(self.actors)  # BUG: closure runs on another thread
+
+        with self._lock:
+            return later

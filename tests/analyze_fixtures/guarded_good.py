@@ -1,0 +1,37 @@
+"""Clean locking discipline, including held-annotated helpers and a
+guarded module global."""
+import threading
+
+_cache_lock = threading.Lock()
+_cache = None  # guarded-by: _cache_lock
+
+
+def load():
+    global _cache
+    with _cache_lock:
+        if _cache is None:
+            _cache = object()
+        return _cache
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cond = threading.Condition(self._lock)
+        self.actors = {}  # guarded-by: self._lock|self.cond
+
+    def get(self, key):
+        with self._lock:
+            return self.actors.get(key)
+
+    def wait_nonempty(self):
+        with self.cond:
+            while not self.actors:
+                self.cond.wait()
+
+    def remove(self, key):
+        with self._lock:
+            self._drop(key)
+
+    def _drop(self, key):  # guarded-by: self._lock held
+        self.actors.pop(key, None)

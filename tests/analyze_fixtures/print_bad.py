@@ -1,0 +1,7 @@
+"""Seeded violations: bare print diagnostics."""
+import traceback
+
+
+def crash_report(exc):
+    print("worker crashed:", exc)
+    traceback.print_exc()

@@ -1,0 +1,21 @@
+"""Seeded violations: unknown op, arity mismatch, dead handler."""
+from raydp_tpu.cluster.common import rpc
+
+
+class MiniServer:
+    def handle_ping(self):
+        return "pong"
+
+    def handle_object_put(self, object_id, owner, size=0):
+        return True
+
+    def handle_never_called(self, x):  # dead handler
+        return x
+
+
+def client(addr):
+    rpc(addr, ("ping", {}))
+    rpc(addr, ("object_put", {"object_id": "a", "owner": "b"}))
+    rpc(addr, ("object_pvt", {"object_id": "a", "owner": "b"}))  # typo'd op
+    rpc(addr, ("object_put", {"object_id": "a", "onwer": "b"}))  # typo'd kwarg
+    rpc(addr, ("object_put", {"object_id": "a"}))  # missing required kwarg

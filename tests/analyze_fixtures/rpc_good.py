@@ -1,0 +1,25 @@
+"""Clean protocol: every op handled, arities bind, envelope unwrapped."""
+from raydp_tpu.cluster.common import rpc, rpc_pooled
+
+
+def head_rpc(method, timeout=60.0, **kwargs):
+    return rpc("addr", (method, kwargs), timeout=timeout)
+
+
+class MiniServer:
+    def handle_ping(self):
+        return "pong"
+
+    def handle_object_put(self, object_id, owner, size=0):
+        return True
+
+    def handle_batch(self, entries, **extra):
+        return len(entries)
+
+
+def client(addr, ctx):
+    rpc(addr, ("ping", {}))
+    rpc_pooled(addr, ("object_put", {"object_id": "a", "owner": "b", "size": 1}))
+    head_rpc("object_put", object_id="a", owner="b", timeout=5.0)
+    # a literal trace envelope unwraps to the inner request
+    rpc(addr, ("__obs__", ctx, ("batch", {"entries": [], "anything": 1})))

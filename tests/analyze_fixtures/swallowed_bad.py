@@ -1,0 +1,16 @@
+"""Seeded violations: silent pass-shaped handlers."""
+
+
+def quiet_loss(store):
+    try:
+        store.delete()
+    except Exception:
+        pass  # the PR 3 delete_failures class: a leak nobody sees
+
+
+def quiet_continue(items):
+    for item in items:
+        try:
+            item.close()
+        except OSError:
+            continue

@@ -1,0 +1,38 @@
+"""Clean handlers: logged, counted, suppressed-with-reason, or real work."""
+from raydp_tpu.obs import log as obs_log
+from raydp_tpu.obs import metrics
+
+
+def logged(store):
+    try:
+        store.delete()
+    except Exception:
+        obs_log.warning("delete failed", exc_info=True)
+
+
+def counted(store):
+    try:
+        store.delete()
+    except Exception:
+        metrics.counter("store.delete_failures").inc()
+
+
+def suppressed(sock):
+    try:
+        sock.close()
+    except OSError:  # raydp-lint: disable=swallowed-exceptions (already closed)
+        pass
+
+
+def optional_dep():
+    try:
+        import torch  # noqa: F401
+    except ImportError:
+        pass  # optional-dependency gating is exempt by design
+
+
+def real_work(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return None  # a meaningful fallback is not a silent swallow

@@ -19,6 +19,11 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
+# runtime donation-aliasing sanitizer (raydp_tpu/sanitize.py): ON for the
+# whole suite so any staging path that hands an externally-owned host alias
+# to a donated jit fails loudly here instead of corrupting params silently
+# in production (the PR 2 streaming-NaN class). Default off outside tests.
+os.environ.setdefault("RAYDP_TPU_SANITIZE", "donation")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

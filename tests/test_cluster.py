@@ -290,6 +290,63 @@ def test_global_zygote_key_and_guards(tmp_path):
     assert _marker_pid_alive(marker) is None
 
 
+def test_zygote_adoption_stamp_blocks_idle_retirement(tmp_path):
+    """ADVICE r5 regression: the idle clock is bumped UNDER the adoption
+    flock (lock-protected adoption stamp) and the retirement path re-checks
+    it after acquiring the same lock — a template exactly at its idle TTL
+    can no longer retire right after a session adopted it (the old
+    post-unlock socket poke left exactly that window)."""
+    from raydp_tpu.cluster.zygote import (
+        GLOBAL_IDLE_TTL_S,
+        adoption_recent,
+        adoption_stamp_path,
+        touch_adoption_stamp,
+    )
+
+    gdir = str(tmp_path)
+    # no adoption ever: nothing vetoes retirement
+    assert not adoption_recent(gdir, GLOBAL_IDLE_TTL_S)
+    # a fresh stamp (what _adopt_global_zygote writes while HOLDING the
+    # flock) vetoes retirement even though the fork-based idle clock is
+    # stale — the exact interleaving of the race
+    touch_adoption_stamp(gdir)
+    assert adoption_recent(gdir, GLOBAL_IDLE_TTL_S)
+    # an adoption older than the TTL no longer vetoes: the adopting session
+    # got a full TTL of service and the template may retire
+    stamp = adoption_stamp_path(gdir)
+    old = time.time() - (GLOBAL_IDLE_TTL_S + 60)
+    os.utime(stamp, (old, old))
+    assert not adoption_recent(gdir, GLOBAL_IDLE_TTL_S)
+
+
+def test_global_zygote_adoption_writes_stamp(tmp_path, monkeypatch):
+    """_adopt_global_zygote leaves the lock-protected adoption stamp in the
+    global template dir (the retirement veto reads it under the same lock)."""
+    import signal
+    import tempfile
+
+    from raydp_tpu.cluster import common
+    from raydp_tpu.cluster.zygote import adoption_recent, zygote_marker_path
+
+    monkeypatch.setattr(tempfile, "gettempdir", lambda: str(tmp_path))
+    run_dir = tmp_path / "session"
+    run_dir.mkdir()
+    root = tmp_path / f"raydp_tpu-zygote-{os.getuid()}"
+    try:
+        assert common._adopt_global_zygote(str(run_dir), dict(os.environ))
+        gdirs = [d for d in root.iterdir() if (d / "zygote.pid").exists()]
+        assert len(gdirs) == 1
+        assert adoption_recent(str(gdirs[0]), 60.0)
+    finally:
+        # the global template ignores parent death by design — kill whatever
+        # adoption spawned, even if an assertion above already failed
+        for marker in root.glob("*/zygote.pid") if root.exists() else ():
+            try:
+                os.kill(int(marker.read_text().strip()), signal.SIGKILL)
+            except (OSError, ValueError):
+                pass
+
+
 @pytest.mark.skipif(
     bool(os.environ.get("RAYDP_TPU_TEST_ATTACH_TCP")),
     reason="introspects the head host's session dir (zygote marker files); "

@@ -93,6 +93,54 @@ def test_sort_global_order(session):
     assert desc == list(reversed(range(500)))
 
 
+def test_sort_null_string_keys(session):
+    """Seed-era crash: the range sampler np.sort'ed an object array with
+    None in it. Nulls now sort LAST in either direction: boundaries are
+    sampled nulls-last (drop_null), null rows route to the last range
+    partition, and the merge sorts with null_placement='at_end'."""
+    import pandas as pd
+
+    keys = [f"k{i:03d}" if i % 3 else None for i in range(90)]
+    pdf = pd.DataFrame({"k": keys, "v": range(90)})
+    df = session.from_pandas(pdf, num_partitions=4)
+
+    non_null = sorted(k for k in keys if k is not None)
+    asc = df.sort("k").to_arrow().column("k").to_pylist()
+    assert asc == non_null + [None] * keys.count(None)
+    desc = df.sort("k", ascending=False).to_arrow().column("k").to_pylist()
+    assert desc == list(reversed(non_null)) + [None] * keys.count(None)
+    # rows stay attached to their keys through the shuffle
+    out = df.sort("k").to_arrow()
+    by_key = dict(zip(keys, range(90)))
+    for k, v in zip(out.column("k").to_pylist(), out.column("v").to_pylist()):
+        if k is not None:
+            assert by_key[k] == v
+
+
+def test_sort_null_numeric_keys(session):
+    import pandas as pd
+
+    vals = [float(i) if i % 4 else None for i in range(60)]
+    pdf = pd.DataFrame({"k": pd.array(vals, dtype="Float64"), "v": range(60)})
+    df = session.from_pandas(pdf, num_partitions=3)
+    n_null = sum(1 for x in vals if x is None)
+    non_null = sorted(x for x in vals if x is not None)
+    asc = df.sort("k").to_arrow().column("k").to_pylist()
+    assert asc == non_null + [None] * n_null
+    desc = df.sort("k", ascending=False).to_arrow().column("k").to_pylist()
+    assert desc == list(reversed(non_null)) + [None] * n_null
+
+
+def test_sort_all_null_keys(session):
+    import pandas as pd
+
+    pdf = pd.DataFrame({"k": [None] * 20, "v": range(20)})
+    df = session.from_pandas(pdf, num_partitions=2)
+    out = df.sort("k").to_arrow()
+    assert out.num_rows == 20
+    assert out.column("k").to_pylist() == [None] * 20
+
+
 def test_distinct_union_limit(session):
     df = session.range(60, num_partitions=3).with_column("m", F.col("id") % 5)
     assert sorted(r["m"] for r in df.select("m").distinct().collect()) == [0, 1, 2, 3, 4]

@@ -1,0 +1,167 @@
+"""Runtime donation-aliasing sanitizer (RAYDP_TPU_SANITIZE=donation).
+
+Reconstructs the PR 2 "streaming NaN" hazard deterministically on CPU jax:
+a 32-byte-aligned numpy buffer is zero-copy-staged by ``jax.device_put``, so
+the resulting device array ALIASES externally-owned host memory — donating
+it hands that memory to XLA for reuse. The sanitizer must raise before
+dispatch on the aliased path and stay silent on the owned-copy path (the
+actual PR 2 fix: ``jnp.array(device_put(x, sharding), copy=True)``).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raydp_tpu import sanitize
+from raydp_tpu.sanitize import (
+    DonationAliasError,
+    checked_jit,
+    note_external_host_buffer,
+)
+
+
+def _aligned(n, align=64, dtype=np.float32):
+    """numpy array aligned enough for jax CPU's zero-copy device_put (the
+    layout orbax-restored / mmap'd checkpoints naturally have)."""
+    nbytes = n * np.dtype(dtype).itemsize
+    raw = np.empty(nbytes + align, np.uint8)
+    offset = (-raw.ctypes.data) % align
+    out = raw[offset : offset + nbytes].view(dtype)
+    out[:] = 1.0
+    return out
+
+
+@pytest.fixture
+def sanitizer_on(monkeypatch):
+    monkeypatch.setenv("RAYDP_TPU_SANITIZE", "donation")
+    yield
+    sanitize._external.clear()
+    sanitize._finalizers.clear()
+
+
+def test_cpu_device_put_zero_copies_aligned_arrays():
+    """The premise of the whole hazard class: on CPU jax, device_put of a
+    suitably-aligned numpy array aliases the host buffer. If a jax upgrade
+    changes this, the sanitizer (and the PR 2 staging dance) can relax."""
+    x = _aligned(1024)
+    staged = jax.device_put(x)
+    assert (
+        staged.unsafe_buffer_pointer() == x.__array_interface__["data"][0]
+    ), "expected zero-copy aliasing on CPU jax for 64-byte-aligned input"
+
+
+def test_donating_registered_alias_raises(sanitizer_on):
+    x = _aligned(1024)
+    note_external_host_buffer(x, tag="repro checkpoint")
+    staged = jax.device_put(x)  # zero-copy: aliases x
+    step = checked_jit(lambda p: p * 2.0, donate_argnums=(0,))
+    with pytest.raises(DonationAliasError, match="externally-owned"):
+        step(staged)
+    # x must be untouched — the sanitizer raised BEFORE dispatch
+    assert float(x[0]) == 1.0
+
+
+def test_owned_copy_path_runs_clean(sanitizer_on):
+    x = _aligned(1024)
+    note_external_host_buffer(x, tag="repro checkpoint")
+    # the PR 2 fix: an owned on-device copy in the target placement
+    owned = jnp.array(jax.device_put(x), copy=True)
+    step = checked_jit(lambda p: p * 2.0, donate_argnums=(0,))
+    out = step(owned)
+    np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+def test_views_register_their_base(sanitizer_on):
+    base = _aligned(4096)
+    view = base[128:1152]  # itself 32-byte aligned within the base
+    note_external_host_buffer(view, tag="arrow view")
+    staged = jax.device_put(base[:1024])
+    if staged.unsafe_buffer_pointer() != base.__array_interface__["data"][0]:
+        pytest.skip("this slice did not zero-copy on this jax build")
+    step = checked_jit(lambda p: p + 1.0, donate_argnums=(0,))
+    with pytest.raises(DonationAliasError):
+        step(staged)
+
+
+def test_aot_lower_compile_is_checked(sanitizer_on):
+    """The scan/stream runners dispatch through .lower(...).compile() — the
+    check must ride along (dodging it there was how the original bug hid)."""
+    x = _aligned(1024)
+    note_external_host_buffer(x, tag="repro checkpoint")
+    staged = jax.device_put(x)
+    step = checked_jit(lambda p: p * 3.0, donate_argnums=(0,))
+    compiled = step.lower(staged).compile()
+    with pytest.raises(DonationAliasError):
+        compiled(staged)
+    owned = jnp.array(jax.device_put(x), copy=True)
+    np.testing.assert_allclose(np.asarray(compiled(owned)), 3.0)
+
+
+def test_disabled_sanitizer_never_raises(monkeypatch):
+    monkeypatch.delenv("RAYDP_TPU_SANITIZE", raising=False)
+    note_external_host_buffer(_aligned(64), tag="ignored")  # no-op when off
+    assert sanitize.external_range_count() == 0
+    x = _aligned(1024)
+    staged = jax.device_put(x)
+    step = checked_jit(lambda p: p * 2.0, donate_argnums=(0,))
+    np.testing.assert_allclose(np.asarray(step(staged)), 2.0)  # no check fires
+
+
+def test_enable_after_jit_build_is_still_checked(monkeypatch):
+    """The env is read at DISPATCH time: a jit built before
+    RAYDP_TPU_SANITIZE was set must still be covered once it is."""
+    monkeypatch.delenv("RAYDP_TPU_SANITIZE", raising=False)
+    step = checked_jit(lambda p: p * 2.0, donate_argnums=(0,))
+    monkeypatch.setenv("RAYDP_TPU_SANITIZE", "donation")
+    try:
+        x = _aligned(1024)
+        note_external_host_buffer(x, tag="late enable")
+        staged = jax.device_put(x)
+        with pytest.raises(DonationAliasError):
+            step(staged)
+    finally:
+        sanitize._external.clear()
+        sanitize._finalizers.clear()
+
+
+def test_registry_drops_collected_buffers(sanitizer_on):
+    x = _aligned(256)
+    note_external_host_buffer(x, tag="short-lived")
+    assert sanitize.external_range_count() >= 1
+    before = sanitize.external_range_count()
+    del x
+    import gc
+
+    gc.collect()
+    assert sanitize.external_range_count() == before - 1
+
+
+def test_estimator_restore_registers_external_leaves(sanitizer_on, tmp_path):
+    """End-to-end PR 2 shape: a checkpoint restored through the estimator's
+    orbax path registers its host leaves, so a hypothetical zero-copy+donate
+    staging would be caught; the estimator's real (copying) staging is clean
+    — exercised by the resume tests in test_jax_estimator.py with the
+    sanitizer on suite-wide."""
+    import orbax.checkpoint as ocp
+
+    from raydp_tpu.estimator.jax_estimator import JaxEstimator
+
+    state = {"params": {"w": np.full((256,), 5.0, np.float32)}}
+    path = tmp_path / "ckpt" / "epoch_0"
+    with ocp.StandardCheckpointer() as ckptr:
+        ckptr.save(str(path), state)
+    est = JaxEstimator.__new__(JaxEstimator)  # only the restore plumbing
+    est.checkpoint_dir = str(tmp_path / "ckpt")
+    before = sanitize.external_range_count()
+    restored = est._restore_checkpoint(0)
+    assert sanitize.external_range_count() > before
+    leaf = restored["params"]["w"]
+    staged = jax.device_put(leaf)
+    step = checked_jit(lambda p: p * 2.0, donate_argnums=(0,))
+    if staged.unsafe_buffer_pointer() == leaf.__array_interface__["data"][0]:
+        with pytest.raises(DonationAliasError):
+            step(staged)
+    owned = jnp.array(jax.device_put(leaf), copy=True)
+    np.testing.assert_allclose(np.asarray(step(owned)), 10.0)

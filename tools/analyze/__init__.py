@@ -1,0 +1,16 @@
+"""raydp-lint: project-specific static analysis (``python -m tools.analyze``).
+
+Each shipped PR's postmortem became a machine-checked invariant here, in the
+lockset/Eraser spirit of checking the *property* instead of re-reproducing the
+bug: donated jit inputs must not alias externally-owned host buffers
+(donation-aliasing — the PR 2 streaming-NaN class), by-name RPC dispatch must
+stay closed over ops and arities (rpc-protocol), exception handlers must not
+swallow silently (swallowed-exceptions — the ``store.delete_failures`` class),
+lock-guarded attributes must be touched under their lock (guarded-by — the
+``_reap_after_kill`` double-read class), and runtime diagnostics must go
+through the structured logger (print-diagnostics).
+
+See docs/analysis.md for the rule catalogue and suppression syntax.
+"""
+
+from tools.analyze.core import Finding, Project, run_rules  # noqa: F401

@@ -1,0 +1,61 @@
+"""CLI: ``python -m tools.analyze [paths...] [--json] [--rule NAME]...``
+
+Exit status 0 when every finding carries a suppression, 1 otherwise — the CI
+gate is exactly ``python -m tools.analyze raydp_tpu/``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+from tools.analyze.core import load_project, render_report, run_rules
+from tools.analyze.rules import ALL_RULES, rules_by_name
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.analyze",
+        description="raydp-lint: project-specific static analysis",
+    )
+    parser.add_argument(
+        "paths", nargs="*", default=["raydp_tpu"],
+        help="files or directories to analyze (default: raydp_tpu)",
+    )
+    parser.add_argument("--json", action="store_true", help="JSON report")
+    parser.add_argument(
+        "--rule", action="append", default=None, metavar="NAME",
+        help="run only the named rule (repeatable); default: all rules",
+    )
+    parser.add_argument(
+        "--list-rules", action="store_true", help="list rules and exit"
+    )
+    args = parser.parse_args(argv)
+
+    registry = rules_by_name()
+    if args.list_rules:
+        for name in sorted(registry):
+            doc = (registry[name].__doc__ or "").strip().splitlines()[0]
+            sys.stdout.write(f"{name}: {doc}\n")
+        return 0
+    if args.rule:
+        unknown = [r for r in args.rule if r not in registry]
+        if unknown:
+            sys.stderr.write(
+                f"unknown rule(s): {', '.join(unknown)} "
+                f"(have: {', '.join(sorted(registry))})\n"
+            )
+            return 2
+        rules = [registry[r]() for r in args.rule]
+    else:
+        rules = [cls() for cls in ALL_RULES]
+
+    project = load_project(args.paths)
+    findings = run_rules(project, rules)
+    report, code = render_report(findings, as_json=args.json)
+    sys.stdout.write(report + "\n")
+    return code
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,265 @@
+"""Analysis framework: file walking, parsing, suppressions, rule running.
+
+A rule sees the whole :class:`Project` (parsed ASTs for every file in scope)
+so cross-file protocol checks (rpc-protocol) and single-file pattern checks
+share one walker and one suppression mechanism.
+
+Suppression syntax (matched via the token stream, never inside strings):
+
+- trailing comment — suppresses the named rules on that line::
+
+      sock.close()  # raydp-lint: disable=swallowed-exceptions
+
+- standalone comment line — suppresses on the next code line::
+
+      # raydp-lint: disable=guarded-by  (monitor thread holds the lock)
+      self.actors.pop(actor_id)
+
+- file-wide — anywhere in the file::
+
+      # raydp-lint: disable-file=print-diagnostics
+
+``disable=all`` suppresses every rule. Suppressed findings still count in the
+JSON report (``"suppressed": true``) so a suppression sweep stays auditable.
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import sys
+import tokenize
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+_SUPPRESS_RE = re.compile(
+    r"raydp-lint:\s*disable(?P<scope>-file)?=(?P<rules>[A-Za-z0-9_,\- ]+)"
+)
+
+
+@dataclasses.dataclass
+class Finding:
+    rule: str
+    path: str
+    line: int
+    col: int
+    message: str
+    suppressed: bool = False
+
+    def render(self) -> str:
+        tag = " (suppressed)" if self.suppressed else ""
+        return f"{self.path}:{self.line}:{self.col}: {self.rule}: {self.message}{tag}"
+
+    def as_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+class SourceFile:
+    """One parsed file: source text, AST, and its suppression map."""
+
+    def __init__(self, path: str, display_path: str, text: str):
+        self.path = path
+        self.display_path = display_path
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(text, filename=path)
+        except SyntaxError as exc:
+            self.parse_error = f"{exc.msg} (line {exc.lineno})"
+        self._line_suppressions: Dict[int, Set[str]] = {}
+        self._file_suppressions: Set[str] = set()
+        self._collect_suppressions()
+
+    def _collect_suppressions(self) -> None:
+        # standalone suppression comments apply to the next code line; track
+        # them until a non-comment logical line consumes them
+        pending: Set[str] = set()
+        pending_lines: List[int] = []
+        comment_only_lines: Set[int] = set()
+        comments: List[Tuple[int, str]] = []
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(self.text).readline):
+                if tok.type == tokenize.COMMENT:
+                    comments.append((tok.start[0], tok.string))
+                    before = self.lines[tok.start[0] - 1][: tok.start[1]]
+                    if not before.strip():
+                        comment_only_lines.add(tok.start[0])
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            # fall back to a line regex; strings containing the marker would
+            # be miscounted, but an untokenizable file rarely has any
+            comments = [
+                (i + 1, line) for i, line in enumerate(self.lines) if "#" in line
+            ]
+            comment_only_lines = {
+                i + 1 for i, line in enumerate(self.lines)
+                if line.strip().startswith("#")
+            }
+        rules_by_line: Dict[int, Set[str]] = {}
+        for lineno, comment in comments:
+            m = _SUPPRESS_RE.search(comment)
+            if not m:
+                continue
+            rules = {
+                r.strip() for r in m.group("rules").split(",") if r.strip()
+            }
+            if m.group("scope"):
+                self._file_suppressions |= rules
+            else:
+                rules_by_line.setdefault(lineno, set()).update(rules)
+        for lineno in sorted(rules_by_line):
+            if lineno in comment_only_lines:
+                pending |= rules_by_line[lineno]
+                pending_lines.append(lineno)
+            else:
+                self._line_suppressions.setdefault(lineno, set()).update(
+                    rules_by_line[lineno]
+                )
+        # attach each standalone run to the first following code line
+        if pending:
+            for lineno in pending_lines:
+                target = lineno + 1
+                while target <= len(self.lines) and (
+                    target in comment_only_lines
+                    or not self.lines[target - 1].strip()
+                ):
+                    target += 1
+                self._line_suppressions.setdefault(target, set()).update(
+                    rules_by_line[lineno]
+                )
+
+    def is_suppressed(self, rule: str, line: int) -> bool:
+        if rule in self._file_suppressions or "all" in self._file_suppressions:
+            return True
+        rules = self._line_suppressions.get(line, ())
+        return rule in rules or "all" in rules
+
+    def finding(self, rule: str, node_or_line, message: str) -> Finding:
+        if isinstance(node_or_line, int):
+            line, col = node_or_line, 0
+        else:
+            line = getattr(node_or_line, "lineno", 1)
+            col = getattr(node_or_line, "col_offset", 0)
+        return Finding(
+            rule=rule,
+            path=self.display_path,
+            line=line,
+            col=col,
+            message=message,
+            suppressed=self.is_suppressed(rule, line),
+        )
+
+
+class Project:
+    def __init__(self, files: Sequence[SourceFile]):
+        self.files = list(files)
+        self._by_path = {f.display_path: f for f in self.files}
+
+    def file(self, display_path: str) -> Optional[SourceFile]:
+        return self._by_path.get(display_path)
+
+    def __iter__(self):
+        return iter(self.files)
+
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv", "build", "dist"}
+
+
+def iter_python_files(paths: Iterable[str]) -> List[str]:
+    out: List[str] = []
+    for path in paths:
+        if os.path.isfile(path):
+            out.append(path)
+            continue
+        for dirpath, dirnames, filenames in os.walk(path):
+            dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
+            for name in sorted(filenames):
+                if name.endswith(".py"):
+                    out.append(os.path.join(dirpath, name))
+    return out
+
+
+def load_project(paths: Iterable[str], root: Optional[str] = None) -> Project:
+    root = root or os.getcwd()
+    files = []
+    for path in iter_python_files(paths):
+        display = os.path.relpath(path, root)
+        if display.startswith(".."):
+            display = path
+        try:
+            with open(path, encoding="utf-8", errors="replace") as f:
+                text = f.read()
+        except OSError as exc:
+            sys.stderr.write(f"raydp-lint: cannot read {path}: {exc}\n")
+            continue
+        files.append(SourceFile(path, display, text))
+    return Project(files)
+
+
+def run_rules(project: Project, rules) -> List[Finding]:
+    findings: List[Finding] = []
+    for src in project:
+        if src.parse_error is not None:
+            findings.append(
+                Finding(
+                    rule="parse-error",
+                    path=src.display_path,
+                    line=1,
+                    col=0,
+                    message=f"file does not parse: {src.parse_error}",
+                )
+            )
+    for rule in rules:
+        findings.extend(rule.check_project(project))
+    findings.sort(key=lambda f: (f.path, f.line, f.col, f.rule))
+    return findings
+
+
+def render_report(findings: List[Finding], as_json: bool) -> Tuple[str, int]:
+    """(report text, exit code). Exit 1 iff any UNSUPPRESSED finding."""
+    active = [f for f in findings if not f.suppressed]
+    if as_json:
+        payload = {
+            "findings": [f.as_dict() for f in findings],
+            "active": len(active),
+            "suppressed": len(findings) - len(active),
+        }
+        return json.dumps(payload, indent=2), 1 if active else 0
+    out = [f.render() for f in active]
+    n_sup = len(findings) - len(active)
+    out.append(
+        f"raydp-lint: {len(active)} finding(s)"
+        + (f", {n_sup} suppressed" if n_sup else "")
+    )
+    return "\n".join(out), 1 if active else 0
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers used by several rules
+# ---------------------------------------------------------------------------
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """'a.b.c' for Name/Attribute chains, else None."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def call_name(node: ast.Call) -> Optional[str]:
+    return dotted_name(node.func)
+
+
+def const_str(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None

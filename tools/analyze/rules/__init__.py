@@ -1,0 +1,20 @@
+"""Rule registry. Adding a checker = one module with a Rule class + one
+import line here (see docs/analysis.md "Adding a checker")."""
+
+from tools.analyze.rules.donation_aliasing import DonationAliasingRule
+from tools.analyze.rules.guarded_by import GuardedByRule
+from tools.analyze.rules.print_diagnostics import PrintDiagnosticsRule
+from tools.analyze.rules.rpc_protocol import RpcProtocolRule
+from tools.analyze.rules.swallowed_exceptions import SwallowedExceptionsRule
+
+ALL_RULES = (
+    DonationAliasingRule,
+    RpcProtocolRule,
+    SwallowedExceptionsRule,
+    GuardedByRule,
+    PrintDiagnosticsRule,
+)
+
+
+def rules_by_name():
+    return {cls.name: cls for cls in ALL_RULES}

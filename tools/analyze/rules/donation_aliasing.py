@@ -1,0 +1,388 @@
+"""donation-aliasing: externally-owned host memory must not reach donated jits.
+
+The PR 2 streaming-NaN use-after-free, as a checkable property: on CPU jax,
+``jax.device_put`` / ``jnp.asarray`` zero-copy suitably-aligned numpy arrays,
+so an array staged from externally-owned host memory (an orbax restore
+result, an Arrow ``to_numpy`` view, ``np.frombuffer``/``memmap``) ALIASES
+that memory — and handing it to a jit built with ``donate_argnums`` lets XLA
+reuse the buffer while its true owner still holds it. The fix is an owned
+copy **in the target sharding**: ``jnp.array(..., copy=True)`` (a host-side
+``np.copy`` does NOT help — the copy is zero-copy-staged and donated all the
+same, which is why plain ``np.copy``/``.copy()`` do not sanitize here).
+
+Heuristic intraprocedural dataflow with light cross-function propagation
+(module-local, call-by-name — covers the builder/runner split in
+``jax_estimator``):
+
+- **origins** (taint): ``*._restore_checkpoint(...)``, ``*.restore(...)``,
+  ``np.frombuffer/memmap/load``, ``*.to_numpy(...)``.
+- **propagators** (keep taint): ``device_put``, ``jnp.asarray``,
+  ``device_put_stacked``/``device_put_batch``, subscripts, tuples, ternaries,
+  and ``jax.tree.map``/``fmap`` whose mapping fn is not itself sanitizing.
+- **sanitizers** (clear taint): ``jnp.array(x)`` / ``jnp.array(x, copy=True)``
+  (device-side owned copy; ``copy=False`` keeps taint), including through a
+  local helper or lambda whose returned expression sanitizes.
+- **sinks**: calls to names bound from ``jax.jit(..., donate_argnums=D)``,
+  ``partial_jit(donate_argnums=D)(fn)`` or ``checked_jit(fn, donate_argnums=D)``
+  with non-empty ``D``; when ``D`` isn't a literal (e.g. ``donate`` resolved
+  through a conditional) every positional argument is treated as donated.
+
+This is a linter, not an alias analysis: unknown calls are assumed to return
+owned values (under-reporting beats drowning the signal), and data that
+crosses module boundaries through containers is not tracked. The runtime half
+of the defence — ``RAYDP_TPU_SANITIZE=donation`` (raydp_tpu/sanitize.py) —
+catches what escapes the static net.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analyze.core import Finding, Project, SourceFile, call_name, const_str
+
+_ORIGIN_LAST = {"_restore_checkpoint", "restore", "to_numpy"}
+_ORIGIN_FULL = {
+    "np.frombuffer", "numpy.frombuffer",
+    "np.memmap", "numpy.memmap",
+    "np.load", "numpy.load",
+}
+_PROPAGATE_LAST = {
+    "device_put", "asarray", "ascontiguousarray",
+    "device_put_stacked", "device_put_batch",
+    "make_array_from_process_local_data",
+    "reshape", "ravel", "squeeze", "astype", "view",
+}
+_TREEMAP_LAST = {"map", "tree_map", "fmap", "_fmap"}
+_JIT_LAST = {"jit", "checked_jit"}
+_JIT_FACTORY_LAST = {"partial_jit", "checked_partial_jit"}
+
+
+def _is_jnp_array_name(name: str) -> bool:
+    return name in ("jnp.array", "jax.numpy.array")
+
+
+def _literal_positions(node: Optional[ast.AST]) -> Optional[Tuple[bool, Set[int]]]:
+    """(donating, positions) from a donate_argnums expression; None when the
+    expression cannot be resolved statically."""
+    if node is None:
+        return (False, set())
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return (True, {node.value})
+    if isinstance(node, (ast.Tuple, ast.List)):
+        positions: Set[int] = set()
+        for elt in node.elts:
+            if isinstance(elt, ast.Constant) and isinstance(elt.value, int):
+                positions.add(elt.value)
+            else:
+                return None
+        return (bool(positions), positions)
+    if isinstance(node, ast.IfExp):
+        a = _literal_positions(node.body)
+        b = _literal_positions(node.orelse)
+        if a is None or b is None:
+            return None
+        return (a[0] or b[0], a[1] | b[1])
+    return None
+
+
+class _FunctionInfo:
+    def __init__(self, node):
+        self.node = node
+        self.param_names = [a.arg for a in node.args.args]
+        self.param_taints: Dict[str, str] = {}  # param -> origin description
+
+
+class _ModuleAnalysis:
+    def __init__(self, rule: "DonationAliasingRule", src: SourceFile):
+        self.rule = rule
+        self.src = src
+        self.functions: Dict[str, _FunctionInfo] = {}
+        self.sanitizing_fns: Set[str] = set()
+        # donated-callable name -> donated positions (None = unknown/all)
+        self.donated: Dict[str, Optional[Set[int]]] = {}
+        self.findings: Dict[Tuple[int, int, str], Finding] = {}
+
+    # -- phase A: tables ----------------------------------------------------
+
+    def collect(self) -> None:
+        for node in ast.walk(self.src.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                self.functions[node.name] = _FunctionInfo(node)
+        for name, info in self.functions.items():
+            if self._fn_sanitizes(info.node):
+                self.sanitizing_fns.add(name)
+        # donated jit assignments anywhere in the module, with a per-scope
+        # pass so `donate = (0, 1) if flag else ()` resolves through the name
+        scopes: List[Sequence[ast.stmt]] = [self.src.tree.body]
+        scopes += [info.node.body for info in self.functions.values()]
+        for body in scopes:
+            literal_env: Dict[str, Tuple[bool, Set[int]]] = {}
+            for stmt in self._flat_statements(body):
+                if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                    continue
+                target = stmt.targets[0]
+                if not isinstance(target, ast.Name):
+                    continue
+                lit = _literal_positions(stmt.value)
+                if lit is not None:
+                    literal_env[target.id] = lit
+                donated = self._donated_positions(stmt.value, literal_env)
+                if donated is not None:
+                    donating, positions = donated
+                    if donating:
+                        self.donated[target.id] = positions
+
+    def _flat_statements(self, body: Sequence[ast.stmt]) -> List[ast.stmt]:
+        out: List[ast.stmt] = []
+        stack = list(body)
+        while stack:
+            stmt = stack.pop(0)
+            out.append(stmt)
+            for field in ("body", "orelse", "finalbody"):
+                sub = getattr(stmt, field, None)
+                if sub and not isinstance(
+                    stmt, (ast.FunctionDef, ast.AsyncFunctionDef, ast.ClassDef)
+                ):
+                    stack.extend(sub)
+            for handler in getattr(stmt, "handlers", ()):
+                stack.extend(handler.body)
+        return out
+
+    def _donate_kw(self, call: ast.Call) -> Optional[ast.AST]:
+        for kw in call.keywords:
+            if kw.arg == "donate_argnums":
+                return kw.value
+        return None
+
+    def _donated_positions(
+        self, value: ast.AST, literal_env: Dict[str, Tuple[bool, Set[int]]]
+    ) -> Optional[Tuple[bool, Optional[Set[int]]]]:
+        """(donating, positions) for a jit-building RHS, else None."""
+        if not isinstance(value, ast.Call):
+            return None
+        name = call_name(value)
+        call = None
+        if name is not None and name.rsplit(".", 1)[-1] in _JIT_LAST:
+            call = value
+        elif isinstance(value.func, ast.Call):
+            inner_name = call_name(value.func)
+            if (
+                inner_name is not None
+                and inner_name.rsplit(".", 1)[-1] in _JIT_FACTORY_LAST
+            ):
+                call = value.func
+        if call is None:
+            return None
+        donate = self._donate_kw(call)
+        if donate is None:
+            return (False, set())
+        if isinstance(donate, ast.Name) and donate.id in literal_env:
+            donating, positions = literal_env[donate.id]
+            return (donating, positions)
+        lit = _literal_positions(donate)
+        if lit is not None:
+            return (lit[0], lit[1])
+        return (True, None)  # unresolvable expression: assume donating, all args
+
+    def _fn_sanitizes(self, node) -> bool:
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Return) and sub.value is not None:
+                if self._expr_sanitizes(sub.value):
+                    return True
+        return False
+
+    def _expr_sanitizes(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name is not None and _is_jnp_array_name(name):
+                for kw in node.keywords:
+                    if kw.arg == "copy" and (
+                        isinstance(kw.value, ast.Constant)
+                        and kw.value.value is False
+                    ):
+                        return False
+                return True
+            if name is not None and name in self.sanitizing_fns:
+                return True
+            if name is not None and name.startswith("self."):
+                return name[len("self."):] in self.sanitizing_fns
+        return False
+
+    def _mapper_sanitizes(self, fn_node: ast.AST) -> bool:
+        if isinstance(fn_node, ast.Lambda):
+            return self._expr_sanitizes(fn_node.body)
+        name = None
+        if isinstance(fn_node, (ast.Name, ast.Attribute)):
+            from tools.analyze.core import dotted_name
+
+            name = dotted_name(fn_node)
+        if name is None:
+            return False
+        bare = name.rsplit(".", 1)[-1]
+        return bare in self.sanitizing_fns or _is_jnp_array_name(name)
+
+    # -- phase B: worklist taint analysis -----------------------------------
+
+    def analyze(self) -> List[Finding]:
+        self.collect()
+        if not self.donated:
+            return []
+        worklist: List[Optional[str]] = [None]  # None = module body
+        worklist += list(self.functions)
+        seen_rounds = 0
+        while worklist and seen_rounds < 4 * (len(self.functions) + 1):
+            name = worklist.pop(0)
+            seen_rounds += 1
+            grew = self._analyze_scope(name)
+            for changed in grew:
+                if changed not in worklist:
+                    worklist.append(changed)
+        return list(self.findings.values())
+
+    def _analyze_scope(self, name: Optional[str]) -> Set[str]:
+        if name is None:
+            body: Sequence[ast.stmt] = self.src.tree.body
+            env: Dict[str, str] = {}
+        else:
+            info = self.functions[name]
+            body = info.node.body
+            env = dict(info.param_taints)
+        grew: Set[str] = set()
+        for stmt in self._flat_statements(body):
+            if isinstance(stmt, ast.Assign):
+                t = self._taint(stmt.value, env)
+                for target in stmt.targets:
+                    self._assign(target, stmt.value, t, env)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                t = self._taint(stmt.value, env)
+                self._assign(stmt.target, stmt.value, t, env)
+            for sub in ast.walk(stmt):
+                if isinstance(sub, ast.Call):
+                    self._check_call(sub, env, grew)
+        return grew
+
+    def _assign(
+        self, target: ast.AST, value: ast.AST, t: Optional[str],
+        env: Dict[str, str],
+    ) -> None:
+        if isinstance(target, ast.Name):
+            if t is None:
+                env.pop(target.id, None)
+            else:
+                env[target.id] = t
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            if isinstance(value, (ast.Tuple, ast.List)) and len(
+                value.elts
+            ) == len(target.elts):
+                for tgt, val in zip(target.elts, value.elts):
+                    self._assign(tgt, val, self._taint(val, env), env)
+            else:
+                for tgt in target.elts:
+                    self._assign(tgt, value, t, env)
+
+    def _taint(self, node: ast.AST, env: Dict[str, str]) -> Optional[str]:
+        if isinstance(node, ast.Name):
+            return env.get(node.id)
+        if isinstance(node, ast.Starred):
+            return self._taint(node.value, env)
+        if isinstance(node, ast.Subscript):
+            return self._taint(node.value, env)
+        if isinstance(node, ast.IfExp):
+            return self._taint(node.body, env) or self._taint(node.orelse, env)
+        if isinstance(node, (ast.Tuple, ast.List)):
+            for elt in node.elts:
+                t = self._taint(elt, env)
+                if t is not None:
+                    return t
+            return None
+        if isinstance(node, ast.Call):
+            return self._call_taint(node, env)
+        if isinstance(node, ast.Await):
+            return self._taint(node.value, env)
+        return None
+
+    def _call_taint(self, node: ast.Call, env: Dict[str, str]) -> Optional[str]:
+        name = call_name(node)
+        if name is None:
+            return None
+        if self._expr_sanitizes(node):
+            return None
+        last = name.rsplit(".", 1)[-1]
+        if name in _ORIGIN_FULL or last in _ORIGIN_LAST:
+            return f"{name}(...) at line {node.lineno}"
+        if last in _TREEMAP_LAST and len(node.args) >= 2:
+            if self._mapper_sanitizes(node.args[0]):
+                return None
+            for arg in node.args[1:]:
+                t = self._taint(arg, env)
+                if t is not None:
+                    return t
+            return None
+        if last in _PROPAGATE_LAST:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                t = self._taint(arg, env)
+                if t is not None:
+                    return t
+            return None
+        return None
+
+    def _check_call(
+        self, node: ast.Call, env: Dict[str, str], grew: Set[str]
+    ) -> None:
+        name = call_name(node)
+        if name is None:
+            return
+        bare = name[len("self."):] if name.startswith("self.") else name
+        if "." in bare:
+            return
+        if bare in self.donated:
+            positions = self.donated[bare]
+            for i, arg in enumerate(node.args):
+                if positions is not None and i not in positions:
+                    continue
+                t = self._taint(arg, env)
+                if t is not None:
+                    donated = (
+                        "all args (donate_argnums not statically resolvable)"
+                        if positions is None
+                        else f"donate_argnums={sorted(positions)}"
+                    )
+                    f = self.src.finding(
+                        self.rule.name, node,
+                        f"argument {i} of donated jit '{bare}' ({donated}) "
+                        f"is staged from externally-owned host memory "
+                        f"(origin: {t}) without an owned copy — use "
+                        "jnp.array(..., copy=True) in the target sharding",
+                    )
+                    self.findings.setdefault((f.line, f.col, f.message), f)
+        if bare in self.functions:
+            info = self.functions[bare]
+            # a self.method(...) call binds positionals starting at param 1
+            offset = (
+                1
+                if name.startswith("self.") and info.param_names[:1] == ["self"]
+                else 0
+            )
+            for i, arg in enumerate(node.args):
+                t = self._taint(arg, env)
+                if t is not None and i + offset < len(info.param_names):
+                    param = info.param_names[i + offset]
+                    if param not in info.param_taints:
+                        info.param_taints[param] = t
+                        grew.add(bare)
+
+
+class DonationAliasingRule:
+    name = "donation-aliasing"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project:
+            if src.tree is None:
+                continue
+            # cheap gate: only modules that mention donation at all
+            if "donate_argnums" not in src.text:
+                continue
+            findings.extend(_ModuleAnalysis(self, src).analyze())
+        return findings

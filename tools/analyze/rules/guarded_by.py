@@ -1,0 +1,269 @@
+"""guarded-by: annotated attributes are only touched under their lock.
+
+The ``_reap_after_kill`` double-read bug class (ADVICE r5): shared mutable
+state read twice outside the lock races a concurrent writer. Eraser-style
+lockset checking, scoped to what Python's dynamism allows: the *author*
+declares the locking discipline with a comment and the checker enforces the
+lexical part of it.
+
+Annotation forms (trailing comments)::
+
+    self.actors = {}          # guarded-by: self.lock
+    _lib = None               # guarded-by: _lib_lock           (module global)
+    def _on_actor_death(...): # guarded-by: self.lock held      (lock held by caller)
+
+- An attribute annotated in a class body is checked across every method of
+  that class: each ``self.<attr>`` load/store must sit lexically inside
+  ``with <lock>`` (alternate lock names: ``lockA|lockB`` — e.g. a Condition
+  constructed over the same lock).
+- ``__init__`` is exempt (no concurrent access before construction returns).
+- A method annotated ``... held`` asserts its callers hold the lock; its body
+  is treated as locked (the claim itself is the reviewable artifact).
+- Nested functions/lambdas reset the lock context — a closure runs later,
+  possibly on another thread — unless their ``def`` carries ``held``.
+- Module-level globals: every Name load/store inside any function must be
+  under ``with <lock>``; module top-level (import-time, single-threaded) is
+  exempt.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import Finding, Project, SourceFile, dotted_name
+
+_ANNOT_RE = re.compile(
+    r"guarded-by:\s*(?P<lock>[A-Za-z0-9_.|]+)\s*(?P<held>held)?"
+)
+
+
+def _annotations(src: SourceFile) -> Dict[int, Tuple[str, bool]]:
+    """line -> (lock spec, is_held_marker) for every guarded-by comment."""
+    out: Dict[int, Tuple[str, bool]] = {}
+    try:
+        tokens = tokenize.generate_tokens(io.StringIO(src.text).readline)
+        comments = [
+            (t.start[0], t.string) for t in tokens if t.type == tokenize.COMMENT
+        ]
+    except (tokenize.TokenError, IndentationError, SyntaxError):
+        comments = [
+            (i + 1, line) for i, line in enumerate(src.lines) if "#" in line
+        ]
+    for lineno, comment in comments:
+        m = _ANNOT_RE.search(comment)
+        if m:
+            out[lineno] = (m.group("lock"), m.group("held") is not None)
+    return out
+
+
+def _assign_target_names(stmt: ast.stmt) -> List[ast.AST]:
+    if isinstance(stmt, ast.Assign):
+        return list(stmt.targets)
+    if isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+        return [stmt.target]
+    return []
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Walk one function body tracking whether a matching lock is held."""
+
+    def __init__(
+        self,
+        rule: "GuardedByRule",
+        src: SourceFile,
+        findings: List[Finding],
+        attrs: Dict[str, str],
+        globals_: Dict[str, str],
+        annotations: Dict[int, Tuple[str, bool]],
+        locked: bool,
+        lock_names: Set[str],
+    ):
+        self.rule = rule
+        self.src = src
+        self.findings = findings
+        self.attrs = attrs  # guarded self-attr -> lock spec
+        self.globals = globals_  # guarded module global -> lock spec
+        self.annotations = annotations
+        self.locked = locked
+        self.lock_names = lock_names  # lock specs currently held
+
+    def _spec_names(self, spec: str) -> Set[str]:
+        return {s.strip() for s in spec.split("|") if s.strip()}
+
+    def _holds(self, spec: str) -> bool:
+        return self.locked and bool(self._spec_names(spec) & self.lock_names)
+
+    def visit_With(self, node: ast.With) -> None:
+        acquired: Set[str] = set()
+        for item in node.items:
+            name = dotted_name(item.context_expr)
+            if name is not None:
+                acquired.add(name)
+        for item in node.items:
+            self.visit(item.context_expr)
+        prev_locked, prev_names = self.locked, set(self.lock_names)
+        if acquired:
+            self.locked = True
+            self.lock_names |= acquired
+        for stmt in node.body:
+            self.visit(stmt)
+        self.locked, self.lock_names = prev_locked, prev_names
+
+    visit_AsyncWith = visit_With
+
+    def _enter_nested(self, node) -> None:
+        annot = self.annotations.get(node.lineno)
+        held = annot is not None and annot[1]
+        inner = _LockWalker(
+            self.rule, self.src, self.findings, self.attrs, self.globals,
+            self.annotations,
+            locked=held,
+            lock_names=self._spec_names(annot[0]) if held else set(),
+        )
+        for stmt in node.body:
+            inner.visit(stmt)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_nested(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        inner = _LockWalker(
+            self.rule, self.src, self.findings, self.attrs, self.globals,
+            self.annotations, locked=False, lock_names=set(),
+        )
+        inner.visit(node.body)
+
+    def visit_Attribute(self, node: ast.Attribute) -> None:
+        if (
+            isinstance(node.value, ast.Name)
+            and node.value.id == "self"
+            and node.attr in self.attrs
+        ):
+            spec = self.attrs[node.attr]
+            if not self._holds(spec):
+                self.findings.append(
+                    self.src.finding(
+                        self.rule.name, node,
+                        f"'self.{node.attr}' is guarded by '{spec}' but "
+                        f"accessed outside 'with {spec}'",
+                    )
+                )
+        self.generic_visit(node)
+
+    def visit_Name(self, node: ast.Name) -> None:
+        if node.id in self.globals:
+            spec = self.globals[node.id]
+            if not self._holds(spec):
+                self.findings.append(
+                    self.src.finding(
+                        self.rule.name, node,
+                        f"global '{node.id}' is guarded by '{spec}' but "
+                        f"accessed outside 'with {spec}'",
+                    )
+                )
+        self.generic_visit(node)
+
+
+class GuardedByRule:
+    name = "guarded-by"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project:
+            if src.tree is None:
+                continue
+            annotations = _annotations(src)
+            if not annotations:
+                continue
+            self._check_file(src, annotations, findings)
+        return findings
+
+    def _check_file(
+        self,
+        src: SourceFile,
+        annotations: Dict[int, Tuple[str, bool]],
+        findings: List[Finding],
+    ) -> None:
+        tree = src.tree
+        # module-level guarded globals: annotated top-level assignments
+        guarded_globals: Dict[str, str] = {}
+        for stmt in tree.body:
+            annot = annotations.get(stmt.lineno)
+            if annot is None or annot[1]:
+                continue
+            for target in _assign_target_names(stmt):
+                if isinstance(target, ast.Name):
+                    guarded_globals[target.id] = annot[0]
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                self._check_class(src, node, annotations, guarded_globals, findings)
+        if guarded_globals:
+            # functions outside any class still must respect guarded globals
+            # (class methods are covered by _check_class, which walks them
+            # whenever guarded attrs OR guarded globals exist)
+            for stmt in tree.body:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    annot = annotations.get(stmt.lineno)
+                    held = annot is not None and annot[1]
+                    walker = _LockWalker(
+                        self, src, findings, {}, guarded_globals, annotations,
+                        locked=held,
+                        lock_names=(
+                            {s for s in annot[0].split("|") if s} if held else set()
+                        ),
+                    )
+                    for sub in stmt.body:
+                        walker.visit(sub)
+
+    def _check_class(
+        self,
+        src: SourceFile,
+        cls: ast.ClassDef,
+        annotations: Dict[int, Tuple[str, bool]],
+        guarded_globals: Dict[str, str],
+        findings: List[Finding],
+    ) -> None:
+        guarded_attrs: Dict[str, str] = {}
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            for stmt in ast.walk(method):
+                if not isinstance(stmt, ast.stmt):
+                    continue
+                annot = annotations.get(stmt.lineno)
+                if annot is None or annot[1]:
+                    continue
+                for target in _assign_target_names(stmt):
+                    if (
+                        isinstance(target, ast.Attribute)
+                        and isinstance(target.value, ast.Name)
+                        and target.value.id == "self"
+                    ):
+                        guarded_attrs[target.attr] = annot[0]
+        if not guarded_attrs and not guarded_globals:
+            return
+        for method in cls.body:
+            if not isinstance(method, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if method.name == "__init__":
+                continue
+            annot = annotations.get(method.lineno)
+            held = annot is not None and annot[1]
+            walker = _LockWalker(
+                self, src, findings, guarded_attrs, guarded_globals,
+                annotations,
+                locked=held,
+                lock_names=(
+                    {s.strip() for s in annot[0].split("|") if s.strip()}
+                    if held
+                    else set()
+                ),
+            )
+            for stmt in method.body:
+                walker.visit(stmt)

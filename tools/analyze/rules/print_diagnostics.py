@@ -1,0 +1,57 @@
+"""print-diagnostics: no bare ``print()`` / ``traceback.print_exc()``.
+
+Crash output from runtime processes must go through the structured logger
+(``raydp_tpu.obs.log``) so every line carries the wall timestamp, process
+role, and actor id — diagnostics interleaved from dozens of processes in the
+session dir are otherwise unattributable. Replaces (and widens to the whole
+package) the grep lint that previously covered only ``cluster/`` in CI.
+
+The logger implementation itself is exempt; deliberate console output (e.g.
+``DataFrame.show()``) carries a line suppression.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List
+
+from tools.analyze.core import Finding, Project, call_name
+
+_ALLOWED_SUFFIXES = ("obs/logging.py",)
+
+
+class PrintDiagnosticsRule:
+    name = "print-diagnostics"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project:
+            if src.tree is None:
+                continue
+            path = src.display_path.replace("\\", "/")
+            if path.endswith(_ALLOWED_SUFFIXES):
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Call):
+                    continue
+                name = call_name(node)
+                if name is None:
+                    continue
+                last = name.rsplit(".", 1)[-1]
+                if last == "print" or name == "print":
+                    findings.append(
+                        src.finding(
+                            self.name, node,
+                            "bare print() — use raydp_tpu.obs.log so the "
+                            "line carries role + actor id",
+                        )
+                    )
+                elif last == "print_exc":
+                    findings.append(
+                        src.finding(
+                            self.name, node,
+                            "traceback.print_exc() — use "
+                            "raydp_tpu.obs.log.exception(...) instead",
+                        )
+                    )
+        return findings

@@ -1,0 +1,217 @@
+"""rpc-protocol: the by-name RPC plane stays closed over ops and arities.
+
+Control-plane dispatch is stringly typed: a caller sends ``("op", {kwargs})``
+(via ``rpc``/``rpc_pooled`` with a request tuple, or the ``head_rpc`` helper)
+and a server resolves ``handle_<op>`` by name and applies ``fn(**kwargs)``.
+Nothing ties the two ends together as the protocol grows every PR — a typo'd
+op or a renamed handler parameter fails only at runtime, on whichever code
+path finally exercises it.
+
+This rule closes the loop statically:
+
+- **server surface** — every class defining ≥2 ``handle_<op>`` methods is a
+  protocol server (Head, NodeAgent); each method contributes an op plus its
+  keyword signature.
+- **call sites** — ``rpc(addr, ("op", {...}))`` / ``rpc_pooled(...)`` with a
+  literal request tuple, and ``head_rpc("op", key=...)``. A literal
+  ``("__obs__", ctx, request)`` trace envelope is unwrapped to the inner
+  request, mirroring ``unwrap_traced``. 4-element tuples are the actor method
+  protocol (dispatch on arbitrary user classes) and are out of scope.
+- **checks** — ``unknown-op`` (call site no server handles), ``arity``
+  (no server's ``handle_<op>`` binds the provided kwargs), ``dead-handler``
+  (a handler no statically-visible call site reaches; suppress on the def
+  line for ops exercised only by tests or reflectively).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import Finding, Project, SourceFile, call_name, const_str
+
+OBS_FRAME_MARK = "__obs__"
+
+
+@dataclasses.dataclass
+class _Handler:
+    op: str
+    cls: str
+    src: SourceFile
+    node: ast.AST
+    required: List[str]
+    optional: List[str]
+    has_var_kw: bool
+
+    def binds(self, kwargs: Set[str]) -> bool:
+        accepted = set(self.required) | set(self.optional)
+        if not self.has_var_kw and not kwargs <= accepted:
+            return False
+        return set(self.required) <= kwargs
+
+    def signature(self) -> str:
+        parts = list(self.required) + [f"{o}=…" for o in self.optional]
+        if self.has_var_kw:
+            parts.append("**kw")
+        return f"{self.cls}.handle_{self.op}({', '.join(parts)})"
+
+
+@dataclasses.dataclass
+class _CallSite:
+    op: str
+    src: SourceFile
+    node: ast.AST
+    kwargs: Optional[Set[str]]  # None = not statically known
+
+
+def _handler_signature(fn: ast.FunctionDef) -> Tuple[List[str], List[str], bool]:
+    args = fn.args
+    names = [a.arg for a in args.args[1:]]  # drop self
+    n_defaults = len(args.defaults)
+    required = names[: len(names) - n_defaults] if n_defaults else list(names)
+    optional = names[len(names) - n_defaults:] if n_defaults else []
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        (optional if d is not None else required).append(a.arg)
+    return required, optional, args.kwarg is not None
+
+
+def _collect_handlers(project: Project) -> Dict[str, List[_Handler]]:
+    handlers: Dict[str, List[_Handler]] = {}
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = [
+                m
+                for m in node.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and m.name.startswith("handle_")
+                and m.name != "handle_request"  # socketserver API, not an op
+            ]
+            if len(methods) < 2:
+                continue
+            for m in methods:
+                required, optional, has_var_kw = _handler_signature(m)
+                handlers.setdefault(m.name[len("handle_"):], []).append(
+                    _Handler(
+                        op=m.name[len("handle_"):],
+                        cls=node.name,
+                        src=src,
+                        node=m,
+                        required=required,
+                        optional=optional,
+                        has_var_kw=has_var_kw,
+                    )
+                )
+    return handlers
+
+
+def _request_from_tuple(node: ast.AST) -> Optional[Tuple[str, Optional[Set[str]]]]:
+    """(op, kwargs or None) from a literal request tuple, unwrapping a
+    literal trace envelope; None when the shape is not the named-op plane."""
+    if not isinstance(node, ast.Tuple):
+        return None
+    elts = node.elts
+    if len(elts) == 3 and const_str(elts[0]) == OBS_FRAME_MARK:
+        return _request_from_tuple(elts[2])
+    if len(elts) != 2:
+        return None  # actor protocol 4-tuples and friends: out of scope
+    op = const_str(elts[0])
+    if op is None:
+        return None
+    kw_node = elts[1]
+    if isinstance(kw_node, ast.Dict):
+        keys: Set[str] = set()
+        for k in kw_node.keys:
+            if k is None:  # **spread — arity unknowable
+                return op, None
+            ks = const_str(k)
+            if ks is None:
+                return op, None
+            keys.add(ks)
+        return op, keys
+    return op, None
+
+
+def _collect_call_sites(project: Project) -> List[_CallSite]:
+    sites: List[_CallSite] = []
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            last = name.rsplit(".", 1)[-1]
+            if last in ("rpc", "rpc_pooled") and len(node.args) >= 2:
+                req = _request_from_tuple(node.args[1])
+                if req is not None:
+                    sites.append(_CallSite(req[0], src, node, req[1]))
+            elif last == "head_rpc" and node.args:
+                op = const_str(node.args[0])
+                if op is None:
+                    continue
+                kwargs: Optional[Set[str]] = set()
+                for kw in node.keywords:
+                    if kw.arg is None:  # **spread
+                        kwargs = None
+                        break
+                    if kw.arg != "timeout":  # consumed by the helper itself
+                        kwargs.add(kw.arg)
+                sites.append(_CallSite(op, src, node, kwargs))
+    return sites
+
+
+class RpcProtocolRule:
+    name = "rpc-protocol"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        handlers = _collect_handlers(project)
+        sites = _collect_call_sites(project)
+        if not handlers:
+            # nothing serves the named-op plane in this scan (e.g. a fixture
+            # subset) — call sites alone cannot be validated
+            return findings
+        called_ops: Set[str] = set()
+        for site in sites:
+            called_ops.add(site.op)
+            cands = handlers.get(site.op)
+            if not cands:
+                findings.append(
+                    site.src.finding(
+                        self.name, site.node,
+                        f"unknown op '{site.op}': no handle_{site.op} on any "
+                        "protocol server",
+                    )
+                )
+                continue
+            if site.kwargs is not None and not any(
+                h.binds(site.kwargs) for h in cands
+            ):
+                sigs = "; ".join(h.signature() for h in cands)
+                sent = ", ".join(sorted(site.kwargs)) or "<none>"
+                findings.append(
+                    site.src.finding(
+                        self.name, site.node,
+                        f"arity mismatch for op '{site.op}': call sends "
+                        f"({sent}) but no handler binds it — {sigs}",
+                    )
+                )
+        for op, hs in sorted(handlers.items()):
+            if op in called_ops:
+                continue
+            for h in hs:
+                findings.append(
+                    h.src.finding(
+                        self.name, h.node,
+                        f"dead handler {h.cls}.handle_{op}: no statically-"
+                        "visible rpc/head_rpc call site sends this op",
+                    )
+                )
+        return findings

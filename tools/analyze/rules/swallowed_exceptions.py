@@ -1,0 +1,97 @@
+"""swallowed-exceptions: no silent ``except: pass``-shaped handlers.
+
+The ``store._delete_blocks`` failures leaked blocks quietly until PR 3 added
+``store.delete_failures`` — this rule makes that class structural: an except
+handler whose body does nothing (``pass`` / ``continue`` / ``break`` / a bare
+docstring) must either log through the structured logger, bump a metrics
+counter, or carry an explicit ``# raydp-lint: disable=swallowed-exceptions``
+suppression stating why swallowing is correct.
+
+Handlers that do real work (return a fallback, set state, retry) are not
+flagged — the target is the *silent* shape. ``ImportError`` /
+``ModuleNotFoundError`` handlers are exempt: optional-dependency gating is
+this repo's sanctioned use of quiet except (the container policy forbids
+installing the missing package anyway).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional
+
+from tools.analyze.core import Finding, Project, call_name
+
+_LOG_SEGMENTS = {"log", "logger", "obs_log", "get_logger", "metrics", "warnings"}
+_LOG_METHODS = {
+    "info", "warning", "error", "exception", "debug", "warn",
+    "inc", "observe", "set",
+}
+_IMPORT_ERRORS = {"ImportError", "ModuleNotFoundError"}
+
+
+def _names_in_type(node: Optional[ast.AST]) -> List[str]:
+    if node is None:
+        return []
+    out = []
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Name):
+            out.append(sub.id)
+        elif isinstance(sub, ast.Attribute):
+            out.append(sub.attr)
+    return out
+
+
+def _is_trivial_stmt(stmt: ast.stmt) -> bool:
+    if isinstance(stmt, (ast.Pass, ast.Continue, ast.Break)):
+        return True
+    if isinstance(stmt, ast.Expr) and isinstance(stmt.value, ast.Constant):
+        return True  # docstring / ellipsis
+    return False
+
+
+def _observes(handler: ast.ExceptHandler) -> bool:
+    """Does the handler body log, count, or re-raise?"""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            name = call_name(node)
+            if name is None:
+                continue
+            parts = name.split(".")
+            if parts[-1] in _LOG_METHODS and (
+                len(parts) == 1 or parts[-2] in _LOG_SEGMENTS or "log" in parts[-2]
+            ):
+                return True
+            if any(p in _LOG_SEGMENTS for p in parts):
+                return True
+    return False
+
+
+class SwallowedExceptionsRule:
+    name = "swallowed-exceptions"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        for src in project:
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ExceptHandler):
+                    continue
+                if not all(_is_trivial_stmt(s) for s in node.body):
+                    continue
+                if _observes(node):
+                    continue
+                type_names = _names_in_type(node.type)
+                if type_names and set(type_names) <= _IMPORT_ERRORS:
+                    continue
+                caught = ", ".join(type_names) if type_names else "everything"
+                findings.append(
+                    src.finding(
+                        self.name, node,
+                        f"silently swallows {caught} — log via obs.log, bump "
+                        "a metrics counter, or suppress with a stated reason",
+                    )
+                )
+        return findings
