@@ -85,7 +85,9 @@ class NodeAgent:
         # removes the children entry; cancelled by a fresh spawn.
         self.FLOOR_PRUNE_GRACE_S = 600.0
         self._floor_prune_at: Dict[str, float] = {}
-        self.lock = threading.RLock()
+        from raydp_tpu import sanitize
+
+        self.lock = sanitize.named_lock("agent.lock", threading.RLock())
         self.stopping = False
         self.addr: Optional[str] = None
         self.node_id: Optional[str] = None
@@ -390,6 +392,14 @@ class NodeAgent:
                 server.handle_request()
         finally:
             server.server_close()
+            from raydp_tpu import sanitize
+
+            try:
+                sanitize.audit_leaks(f"agent:{self.node_ip}")
+            except sanitize.LeakError:
+                obs_log.error(
+                    "agent leaked resources at shutdown", exc_info=True
+                )
 
 
 def main() -> None:
@@ -399,6 +409,9 @@ def main() -> None:
     # node-qualified role: two agents on different hosts can share an OS
     # pid, and the (role, pid) pair keys metric snapshots and trace tracks
     set_process_role(f"agent:{node_ip}")
+    from raydp_tpu import sanitize
+
+    sanitize.snapshot_baseline()  # leak-audit floor for this agent process
     # anchor the serving root: the spill-path sanitizer pins file:// block
     # reads/unlinks to THIS node's spill dir
     os.environ[SESSION_ENV] = local_dir

@@ -43,7 +43,10 @@ from raydp_tpu.cluster.common import (
     wait_for_path,
 )
 
-_lock = threading.RLock()
+from raydp_tpu import sanitize as _sanitize
+
+_lock = _sanitize.named_lock("cluster.api", threading.RLock())
+_shutting_down = False  # teardown claimed; guarded-by: _lock
 _session_dir: Optional[str] = None
 _head_proc: Optional[subprocess.Popen] = None
 _is_client = False  # attached to someone else's cluster: detach, never tear down
@@ -153,6 +156,7 @@ def init(
 
         os.environ[TOKEN_ENV] = load_token(_session_dir).hex()
         atexit.register(shutdown)
+        _sanitize.snapshot_baseline()  # leak audit floor for THIS session
         return _session_dir
 
 
@@ -206,6 +210,11 @@ def connect_cluster(address: str, token: Optional[str] = None) -> str:
         os.environ.update(set_env)
         _session_dir = local_dir
         try:
+            # raydp-lint: disable=blocking-under-lock (attach validation must
+            # be atomic with the attach state it validates: a concurrent
+            # init() observing a half-attached session would race the
+            # rollback below. The ping is a leaf RPC — its path takes no
+            # other lock, so no inversion is possible — and bounded at 10s.)
             head_rpc("ping", timeout=10)  # validate before committing
         except BaseException:
             # roll back: a typo'd address must not poison the process
@@ -222,6 +231,7 @@ def connect_cluster(address: str, token: Optional[str] = None) -> str:
         _is_client = True
         _is_tcp_client = address.startswith("tcp://")
         _client_env_keys.extend(set_env)
+        _sanitize.snapshot_baseline()  # leak audit floor for THIS attach
         return _session_dir
 
 
@@ -256,23 +266,55 @@ def shutdown() -> None:
         if os.environ.get(SESSION_ENV):  # actors never tear the session down
             _session_dir = None
             return
+        # claim teardown under the lock; RUN it off the lock. The shutdown
+        # RPC and process waits block for up to tens of seconds, and holding
+        # the api lock through them froze every other thread touching the
+        # cluster API — the exact hold-lock-while-blocking shape the
+        # blocking-under-lock rule exists for. A concurrent caller returns
+        # immediately (_shutting_down claimed) instead of queueing behind
+        # the whole teardown; state is cleared only AFTER the teardown
+        # completes, so an interrupt (Ctrl-C in a process wait) leaves the
+        # session claimable again and the atexit retry can still reap the
+        # head/agent processes instead of orphaning them.
+        global _shutting_down
+        if _shutting_down:
+            return  # teardown already in flight on another thread
+        _shutting_down = True
         try:
-            head_rpc("shutdown", timeout=10)
-        except Exception:  # raydp-lint: disable=swallowed-exceptions (head may already be gone at shutdown)
-            pass
-        if _head_proc is not None:
+            head_addr = resolve_head_addr(_session_dir)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (session dir already gone: nothing to signal)
+            head_addr = None
+        head_proc = _head_proc
+        agent_procs = list(_agent_procs)
+    done = False
+    try:
+        if head_addr is not None:
             try:
-                _head_proc.wait(timeout=10)
+                rpc(head_addr, ("shutdown", {}), timeout=10)
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (head may already be gone at shutdown)
+                pass
+        if head_proc is not None:
+            try:
+                head_proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
-                _head_proc.kill()
-            _head_proc = None
-        for proc in _agent_procs:
+                head_proc.kill()
+        for proc in agent_procs:
             try:
                 proc.wait(timeout=10)
             except subprocess.TimeoutExpired:
                 proc.kill()
-        _agent_procs.clear()
-        _session_dir = None
+        done = True
+    finally:
+        with _lock:
+            _shutting_down = False
+            if done:
+                _head_proc = None
+                _agent_procs.clear()
+                _session_dir = None
+    from raydp_tpu.cluster.common import close_pooled_connections
+
+    close_pooled_connections()
+    _sanitize.audit_leaks("cluster.shutdown")
 
 
 # ---------- actors ----------

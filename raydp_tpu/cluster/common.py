@@ -271,6 +271,18 @@ def _pool_drop(addr: str) -> None:
                 pass
 
 
+def close_pooled_connections() -> None:
+    """Close THIS thread's pooled RPC sockets (shutdown hygiene: the pool
+    keeps one live socket per address for the thread's lifetime, which the
+    leak sanitizer's fd audit would otherwise count against the baseline
+    forever)."""
+    conns = getattr(_rpc_pool_tls, "conns", None)
+    if not conns:
+        return
+    for addr in list(conns):
+        _pool_drop(addr)
+
+
 def rpc_pooled(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) -> Any:
     """Request/response over a cached per-thread connection. A stale cached
     connection (server restarted / closed idle) is dropped and the request
@@ -438,6 +450,9 @@ def serve_block_bytes(shm_name: str, offset: int = 0, length: int = -1) -> bytes
 
 def unlink_block(shm_name: str) -> None:
     """Remove a block in either tier (shared by head and agents)."""
+    from raydp_tpu import sanitize
+
+    sanitize.untrack_block(shm_name)
     try:
         if shm_name.startswith("file://"):
             os.unlink(safe_spill_path(shm_name))

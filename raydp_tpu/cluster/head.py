@@ -43,6 +43,7 @@ from raydp_tpu.cluster.common import (
     send_frame,
     unwrap_traced,
 )
+from raydp_tpu import sanitize
 from raydp_tpu.obs import instant as obs_instant
 from raydp_tpu.obs import log as obs_log
 from raydp_tpu.obs import metrics as obs_metrics
@@ -119,10 +120,12 @@ class Head:
     def __init__(self, session_dir: str, driver_pid: int, default_resources: Dict[str, float]):
         self.session_dir = session_dir
         self.driver_pid = driver_pid
-        self.lock = threading.RLock()
+        self.lock = sanitize.named_lock("head.lock", threading.RLock())
         # woken whenever an actor reaches ALIVE or DEAD — lets clients block
         # in handle_wait_actor_ready instead of sleep-polling get_actor
-        # (polling put ~1.1s of pure sleep on session startup's critical path)
+        # (polling put ~1.1s of pure sleep on session startup's critical path).
+        # Wrapping the lockdep proxy keeps cond and lock ONE lockdep node —
+        # they are the same mutex.
         self.actor_state_cond = threading.Condition(self.lock)
         # shared cluster state, mutated by handler threads AND the monitor
         # loop — every access must hold self.lock (the condition below wraps
@@ -1155,11 +1158,15 @@ class Head:
             for t in threads:
                 t.start()
             for t in threads:
-                # full join: probes are bounded by their own rpc timeouts; a
-                # timed-out join would leave a straggler mutating `results`
-                # mid-iteration and crash this watchdog permanently
-                t.join()
-            for node_id, ok in results.items():
+                # bounded join with slack over the probes' own 3s rpc
+                # timeout: a probe stuck past its timeout (half-open TCP,
+                # resolver hang) must not park this watchdog forever — the
+                # lost-notify/unbounded-join class the raydp-tsan audit
+                # covers. Stragglers report into `results` late; the
+                # snapshot below keeps their mutation off this iteration
+                # and the next sweep picks the node up again.
+                t.join(timeout=10.0)
+            for node_id, ok in dict(results).items():
                 if ok:
                     agent_last_ok[node_id] = now
                     continue
@@ -1264,6 +1271,7 @@ def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, flo
     from raydp_tpu.obs.tracing import set_local_ingest, set_process_role
 
     set_process_role("head")
+    sanitize.snapshot_baseline()
     head = Head(session_dir, driver_pid, default_resources)
     # the head's own spans/metrics ingest directly — no RPC loopback
     set_local_ingest(head.handle_obs_ingest)
@@ -1318,3 +1326,7 @@ def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, flo
         server.server_close()
         tcp_server.shutdown()
         tcp_server.server_close()
+        try:
+            sanitize.audit_leaks("head")
+        except sanitize.LeakError:
+            obs_log.error("head leaked resources at shutdown", exc_info=True)

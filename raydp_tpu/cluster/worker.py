@@ -189,6 +189,12 @@ def main() -> None:
     # re-reads RAYDP_TPU_TRACE: a zygote-forked worker inherits the ZYGOTE's
     # tracing state, but this SESSION's env (riding the fork request) decides
     reinit_for_process(f"worker:{actor_id}")
+    from raydp_tpu import sanitize
+
+    # the zygote parent's lock-order history and resource floor are
+    # meaningless in this fork; start both sanitizers clean
+    sanitize.reset_lockdep()
+    sanitize.snapshot_baseline()
     head = resolve_head_addr(session_dir)
 
     spec_path = os.path.join(session_dir, f"a-{actor_id}.spec")
@@ -253,6 +259,15 @@ def main() -> None:
             )
     from raydp_tpu.obs import flush as obs_flush
 
+    # graceful teardown audits this worker's inventory back to its baseline
+    # (SIGKILLed actors never reach here — their segments are reclaimed by
+    # owner-death GC, and the head/agent side unlinks them); the gauges ride
+    # the final flush below into cluster.dump_metrics()
+    try:
+        sanitize.audit_leaks(f"worker:{actor_id}")
+    except sanitize.LeakError:
+        obs_log.error("worker leaked resources at graceful exit",
+                      actor_id=actor_id, exc_info=True)
     obs_flush()  # graceful exits ship their remaining spans/metrics
 
 

@@ -78,7 +78,9 @@ class Planner:
         # idle-timeout never kills executors under a running stage
         self.scale_hook = None
         self._inflight = 0
-        self._inflight_lock = threading.Lock()
+        from raydp_tpu.sanitize import named_lock
+
+        self._inflight_lock = named_lock("planner.inflight")
 
     def __getstate__(self):
         # planners travel inside pickled sessions (Dataset._session → workers);
@@ -98,7 +100,9 @@ class Planner:
         self.__dict__.update(state)
         self._tls = threading.local()
         self.scale_hook = None
-        self._inflight_lock = threading.Lock()
+        from raydp_tpu.sanitize import named_lock
+
+        self._inflight_lock = named_lock("planner.inflight")
         self.__dict__.setdefault("fuse_projects", True)
         self.__dict__.setdefault("executor_slots", 1)
         self.__dict__.setdefault("shuffle_indexed_blocks", True)
@@ -1517,12 +1521,13 @@ class _ReduceLauncher:
     from concurrent threads."""
 
     def __init__(self, planner: Planner, num_reducers: int, spec_fn):
-        import threading
+        from raydp_tpu.sanitize import named_lock
 
         self.planner = planner
         self.n = num_reducers
         self.spec_fn = spec_fn  # (r, [ReadSpec per side]) -> TaskSpec
-        self._lock = threading.Lock()
+        # class-wide lockdep key: every launcher instance shares one node
+        self._lock = named_lock("planner.reduce_launcher")
         self._sides: List[dict] = []
         self._launched = False
         self._aborted = False

@@ -39,7 +39,9 @@ from raydp_tpu.etl.tasks import write_table_block
 from raydp_tpu.store.object_store import ObjectHolder
 from raydp_tpu.utils import parse_memory_size
 
-_lock = threading.RLock()
+from raydp_tpu.sanitize import named_lock as _named_lock
+
+_lock = _named_lock("etl.session", threading.RLock())
 _active_session: Optional["EtlSession"] = None
 
 MASTER_ACTOR_SUFFIX = "_ETL_MASTER"  # parity: RAYDP_SPARK_MASTER_SUFFIX

@@ -83,7 +83,9 @@ class Registry:
     float-add races whose worst case is a lost increment)."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        from raydp_tpu.sanitize import named_lock
+
+        self._lock = named_lock("obs.metrics_registry")
         self._instruments: Dict[str, Any] = {}
 
     def _get(self, name: str, cls):

@@ -39,8 +39,10 @@ BUFFER_ENV = "RAYDP_TPU_TRACE_BUFFER"
 _enabled = os.environ.get(TRACE_ENV, "0") not in ("", "0", "false", "False")
 _buffer_cap = int(os.environ.get(BUFFER_ENV, "8192") or "8192")
 
+from raydp_tpu.sanitize import named_lock as _named_lock
+
 _tls = threading.local()
-_buf_lock = threading.Lock()
+_buf_lock = _named_lock("obs._buf_lock")
 _buffer: "collections.deque" = collections.deque(maxlen=_buffer_cap)
 _dropped = 0  # spans evicted from the ring before a flush shipped them
 

@@ -43,9 +43,11 @@ class SpmdWorker:
     see it; nothing is re-applied here."""
 
     def __init__(self, job_name: str, rank: int, world_size: int):
+        from raydp_tpu.sanitize import named_lock
+
         self.ctx = WorkerContext(job_name, rank, world_size)
         self._next_func_id = 0
-        self._lock = threading.Lock()
+        self._lock = named_lock("spmd.worker")
 
     def ping(self) -> int:
         return self.ctx.rank
@@ -125,7 +127,9 @@ class SpmdJob:
         self._workers: List[cluster.ActorHandle] = []
         self._func_id = 0
         self._started = False
-        self._lock = threading.RLock()
+        from raydp_tpu.sanitize import named_lock
+
+        self._lock = named_lock("spmd.job", threading.RLock())
 
     # ------------------------------------------------------------------
 
@@ -353,6 +357,14 @@ class SpmdJob:
 
         from raydp_tpu.cluster.common import ActorState
 
+        # The whole teardown runs UNDER the job lock ON PURPOSE: stop() is
+        # only "done" once the ranks are DEAD and the PG's bundles are back,
+        # and a start() admitted mid-drain would see self._pg already None,
+        # fail to create a new PG against the still-reserved bundles, and
+        # fall into its add_node() fallback — permanently growing the
+        # cluster. The lock is the job's lifecycle serializer, its hold is
+        # bounded by the 15s drain deadline, and nothing under it takes any
+        # other instrumented lock, so no inversion is possible.
         with self._lock:
             killed = list(self._workers)
             for w in killed:
@@ -375,6 +387,7 @@ class SpmdJob:
                             break
                     except Exception:  # raydp-lint: disable=swallowed-exceptions (polling a dying actor)
                         break
+                    # raydp-lint: disable=blocking-under-lock (deliberate, deadline-bounded hold — see the lifecycle-serializer comment above)
                     time.sleep(0.05)
             if self._owns_pg and self._pg is not None:
                 try:

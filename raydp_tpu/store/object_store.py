@@ -50,7 +50,9 @@ stats = {"remote_fetches": 0, "remote_bytes": 0}
 
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libraydp_store.so")
-_lib_lock = threading.Lock()
+from raydp_tpu import sanitize as _sanitize
+
+_lib_lock = _sanitize.named_lock("store._lib_lock", threading.Lock())
 _lib: Optional[ctypes.CDLL] = None  # guarded-by: _lib_lock
 
 
@@ -68,6 +70,11 @@ def _load_native() -> ctypes.CDLL:
             with open(lock_path, "w") as lock_file:
                 fcntl.flock(lock_file, fcntl.LOCK_EX)
                 if not os.path.exists(_LIB_PATH):
+                    # raydp-lint: disable=blocking-under-lock (one-time lazy
+                    # build of the native store: every caller needs the
+                    # library before it can do anything, releasing the lock
+                    # would only let threads race duplicate compiles, and
+                    # this path takes no other lock — no inversion possible)
                     subprocess.run(
                         ["sh", os.path.join(_NATIVE_DIR, "build.sh")],
                         check=True,
@@ -184,6 +191,9 @@ class WritableBlock:
         self._file = open("/dev/shm" + self._name.decode(), "r+b")
         self._mmap = _mmap.mmap(self._file.fileno(), capacity)
         self._sealed = False
+        _sanitize.track_block(
+            self._name.decode(), "/dev/shm" + self._name.decode()
+        )
 
     def arrow_sink(self):
         """A pyarrow FixedSizeBufferWriter over the raw segment (writes stream
@@ -404,6 +414,7 @@ class _SpillBlock:
         os.ftruncate(self._file.fileno(), max(capacity, 1))
         self._mmap = _mmap.mmap(self._file.fileno(), max(capacity, 1))
         self._sealed = False
+        _sanitize.track_block(f"file://{self.path}", self.path, kind="spill")
 
     def arrow_sink(self):
         import pyarrow as pa
@@ -558,6 +569,7 @@ def host_block_locally(
             name.encode(), ctypes.cast(cbuf, ctypes.c_void_p), n
         )
         if rc == 0:
+            _sanitize.track_block(name, "/dev/shm" + name)
             return name
         if storage == "shm":  # strict tier: no silent downgrade to disk
             raise OSError(f"shm put failed (errno={lib.rtpu_errno()})")
@@ -566,6 +578,7 @@ def host_block_locally(
     path = os.path.join(base, f"rtpu-{object_id}")
     with open(path, "wb") as f:
         f.write(payload)
+    _sanitize.track_block(f"file://{path}", path, kind="spill")
     return f"file://{path}"
 
 
@@ -611,6 +624,7 @@ def put(data, owner: Optional[str] = None, storage: str = "auto") -> ObjectRef:
         if storage == "shm":
             raise OSError(f"shm put failed (errno={lib.rtpu_errno()})")
         return _put_spill(object_id, buf, owner)
+    _sanitize.track_block(ref.shm_name, "/dev/shm" + ref.shm_name)
     try:
         _register(ref, owner)
     except BaseException:
@@ -623,6 +637,7 @@ def _put_spill(object_id: str, buf, owner: Optional[str]) -> ObjectRef:
     path = os.path.join(_spill_dir(), f"rtpu-{object_id}")
     with open(path, "wb") as f:
         f.write(memoryview(buf))
+    _sanitize.track_block(f"file://{path}", path, kind="spill")
     ref = ObjectRef(object_id, buf.size)
     try:
         _register(ref, owner, shm_name=f"file://{path}")

@@ -1,0 +1,44 @@
+"""Seeded blocking-under-lock violations: every class of blocking call the
+rule covers, each executed while an instrumented lock is held."""
+
+import subprocess
+import threading
+import time
+
+from raydp_tpu.cluster.common import rpc
+
+
+class Master:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.state = {}
+        self.proc = None
+
+    def refresh(self, addr):
+        with self.lock:
+            return rpc(addr, ("pull", {}))  # BUG: RPC under lock
+
+    def pause(self):
+        with self.lock:
+            time.sleep(1.0)  # BUG: sleep under lock
+
+    def wait_ready(self):
+        with self.cond:
+            self.cond.wait()  # BUG: unbounded Condition.wait()
+
+    def gather(self, futures):
+        with self.lock:
+            return [f.result() for f in futures]  # BUG: result() under lock
+
+    def sync(self, params, jax):
+        with self.lock:
+            return jax.block_until_ready(params)  # BUG: device sync under lock
+
+    def reap(self):
+        with self.lock:
+            self.proc.communicate()  # BUG: subprocess wait under lock
+
+    def rebuild(self):
+        with self.lock:
+            subprocess.run(["make"], check=True)  # BUG: subprocess under lock

@@ -1,0 +1,59 @@
+"""The blocking_bad.py scenarios fixed the way the rule's message says:
+snapshot state under the lock, block OUTSIDE it; waits bounded with a
+predicate re-check loop. The blocking-under-lock rule must stay silent."""
+
+import subprocess
+import threading
+import time
+
+from raydp_tpu.cluster.common import rpc
+
+
+class Master:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.state = {}
+        self.proc = None
+        self.ready = False
+
+    def refresh(self, addr):
+        with self.lock:
+            snapshot = dict(self.state)  # state read under the lock
+        reply = rpc(addr, ("pull", {"have": snapshot}))  # RPC off-lock
+        with self.lock:
+            self.state.update(reply)
+        return reply
+
+    def pause(self):
+        time.sleep(1.0)  # off-lock
+
+    def wait_ready(self, timeout=30.0):
+        deadline = time.monotonic() + timeout
+        with self.cond:
+            # bounded wait + predicate re-check: a lost notify costs one
+            # re-check period, never a hang
+            while not self.ready:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self.cond.wait(min(remaining, 0.25))
+        return True
+
+    def gather(self, futures):
+        return [f.result() for f in futures]  # off-lock
+
+    def sync(self, params, jax):
+        ready = jax.block_until_ready(params)  # off-lock
+        with self.lock:
+            self.state["params"] = ready
+        return ready
+
+    def reap(self):
+        with self.lock:
+            proc = self.proc  # snapshot the handle under the lock
+        if proc is not None:
+            proc.communicate()  # wait off-lock
+
+    def rebuild(self):
+        subprocess.run(["make"], check=True)  # off-lock

@@ -1,0 +1,41 @@
+"""Seeded lock-order violations: two lexical inversions (one through a
+Condition alias, one through a guarded-by-held interprocedural edge).
+Exercised by tests/test_analyze.py; excluded from the repo sweep via the
+setup.cfg [raydp-lint] exclude list."""
+
+import threading
+
+_flush_lock = threading.Lock()
+
+
+class Registry:
+    def __init__(self):
+        self.lock = threading.RLock()
+        # same mutex as self.lock: the rule must collapse them to one node
+        self.cond = threading.Condition(self.lock)
+        self.items = {}
+
+    def ingest(self, batch):
+        with self.lock:
+            with _flush_lock:  # order: Registry.lock -> _flush_lock
+                self.items.update(batch)
+
+    def flush(self):
+        with _flush_lock:
+            with self.cond:  # BUG: _flush_lock -> Registry.lock (inverted)
+                return dict(self.items)
+
+
+class Pool:
+    def __init__(self):
+        self._slots_lock = threading.Lock()
+        self.slots = []
+
+    def _grow(self):  # guarded-by: _flush_lock held
+        with self._slots_lock:  # order: _flush_lock -> Pool._slots_lock
+            self.slots.append(object())
+
+    def shrink(self):
+        with self._slots_lock:
+            with _flush_lock:  # BUG: Pool._slots_lock -> _flush_lock (inverted)
+                self.slots.pop()

@@ -1,0 +1,38 @@
+"""The lockorder_bad.py scenarios with one consistent acquisition order
+(every path takes _flush_lock AFTER the object's own lock): the lock-order
+rule must stay silent."""
+
+import threading
+
+_flush_lock = threading.Lock()
+
+
+class Registry:
+    def __init__(self):
+        self.lock = threading.RLock()
+        self.cond = threading.Condition(self.lock)
+        self.items = {}
+
+    def ingest(self, batch):
+        with self.lock:
+            with _flush_lock:  # order: Registry.lock -> _flush_lock
+                self.items.update(batch)
+
+    def flush(self):
+        with self.cond:  # same order via the Condition alias
+            with _flush_lock:
+                return dict(self.items)
+
+
+class Pool:
+    def __init__(self):
+        self._slots_lock = threading.Lock()
+        self.slots = []
+
+    def _grow(self):  # guarded-by: _slots_lock held
+        self.slots.append(object())
+
+    def shrink(self):
+        with self._slots_lock:
+            with _flush_lock:  # order: Pool._slots_lock -> _flush_lock
+                self.slots.pop()

@@ -19,11 +19,14 @@ if "xla_force_host_platform_device_count" not in _flags:
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 os.environ.setdefault("TF_CPP_MIN_LOG_LEVEL", "3")
-# runtime donation-aliasing sanitizer (raydp_tpu/sanitize.py): ON for the
-# whole suite so any staging path that hands an externally-owned host alias
-# to a donated jit fails loudly here instead of corrupting params silently
-# in production (the PR 2 streaming-NaN class). Default off outside tests.
-os.environ.setdefault("RAYDP_TPU_SANITIZE", "donation")
+# runtime sanitizers (raydp_tpu/sanitize.py): ON for the whole suite —
+# `donation` fails loudly on externally-owned host aliases reaching donated
+# jits (the PR 2 streaming-NaN class), `lockdep` raises LockOrderError the
+# moment any lock acquisition closes an order cycle (even when the run never
+# actually deadlocks), and `leaks` makes cluster/worker teardown audit
+# threads/fds/shm segments/spill files back to the startup baseline
+# (sanitize.leaked_* gauges). Default off outside tests.
+os.environ.setdefault("RAYDP_TPU_SANITIZE", "donation,lockdep,leaks")
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 

@@ -1,16 +1,61 @@
-"""CLI: ``python -m tools.analyze [paths...] [--json] [--rule NAME]...``
+"""CLI: ``python -m tools.analyze [paths...] [--json] [--rule NAMES]
+[--exclude PATTERN]``
 
 Exit status 0 when every finding carries a suppression, 1 otherwise — the CI
-gate is exactly ``python -m tools.analyze raydp_tpu/``.
+gate is ``python -m tools.analyze raydp_tpu/ tools/ tests/conftest.py``
+(the analyzer is self-hosted: its own source is swept).
+
+``--rule`` takes a comma-separated list and is repeatable
+(``--rule lock-order,blocking-under-lock``). ``--exclude`` removes files by
+fnmatch pattern against the repo-relative path; default exclusions come from
+``setup.cfg``'s ``[raydp-lint] exclude`` (the seeded-violation fixtures under
+tests/analyze_fixtures/ live there, not as a hardcoded path check).
 """
 
 from __future__ import annotations
 
 import argparse
+import configparser
+import os
 import sys
 
 from tools.analyze.core import load_project, render_report, run_rules
 from tools.analyze.rules import ALL_RULES, rules_by_name
+
+
+def find_root(paths) -> str:
+    """The directory whose setup.cfg governs this run: walk up from the
+    first analyzed path (so the excludes apply no matter where the CLI is
+    invoked from), falling back to the cwd."""
+    for path in list(paths) + [os.getcwd()]:
+        probe = os.path.abspath(path)
+        if os.path.isfile(probe):
+            probe = os.path.dirname(probe)
+        while True:
+            if os.path.isfile(os.path.join(probe, "setup.cfg")):
+                return probe
+            parent = os.path.dirname(probe)
+            if parent == probe:
+                break
+            probe = parent
+    return os.getcwd()
+
+
+def config_excludes(root: str) -> list:
+    """Exclusion patterns from ``[raydp-lint] exclude`` in setup.cfg (one
+    per line or comma-separated)."""
+    cfg = configparser.ConfigParser()
+    try:
+        cfg.read(os.path.join(root, "setup.cfg"))
+    except configparser.Error:
+        return []
+    raw = cfg.get("raydp-lint", "exclude", fallback="")
+    return [
+        pattern.strip()
+        for chunk in raw.splitlines()
+        for pattern in chunk.split(",")
+        if pattern.strip()
+    ]
 
 
 def main(argv=None) -> int:
@@ -24,8 +69,14 @@ def main(argv=None) -> int:
     )
     parser.add_argument("--json", action="store_true", help="JSON report")
     parser.add_argument(
-        "--rule", action="append", default=None, metavar="NAME",
-        help="run only the named rule (repeatable); default: all rules",
+        "--rule", action="append", default=None, metavar="NAMES",
+        help="run only the named rule(s); comma-separated and repeatable "
+        "(default: all rules)",
+    )
+    parser.add_argument(
+        "--exclude", action="append", default=[], metavar="PATTERN",
+        help="exclude files matching this fnmatch pattern (repeatable; "
+        "added to setup.cfg [raydp-lint] exclude)",
     )
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
@@ -39,18 +90,26 @@ def main(argv=None) -> int:
             sys.stdout.write(f"{name}: {doc}\n")
         return 0
     if args.rule:
-        unknown = [r for r in args.rule if r not in registry]
+        wanted = [
+            name.strip()
+            for spec in args.rule
+            for name in spec.split(",")
+            if name.strip()
+        ]
+        unknown = [r for r in wanted if r not in registry]
         if unknown:
             sys.stderr.write(
                 f"unknown rule(s): {', '.join(unknown)} "
                 f"(have: {', '.join(sorted(registry))})\n"
             )
             return 2
-        rules = [registry[r]() for r in args.rule]
+        rules = [registry[r]() for r in wanted]
     else:
         rules = [cls() for cls in ALL_RULES]
 
-    project = load_project(args.paths)
+    root = find_root(args.paths)
+    exclude = config_excludes(root) + list(args.exclude)
+    project = load_project(args.paths, root=root, exclude=exclude)
     findings = run_rules(project, rules)
     report, code = render_report(findings, as_json=args.json)
     sys.stdout.write(report + "\n")

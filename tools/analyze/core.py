@@ -169,27 +169,57 @@ class Project:
 _SKIP_DIRS = {"__pycache__", ".git", "node_modules", ".venv", "build", "dist"}
 
 
+def _excluded(display: str, exclude: Sequence[str]) -> bool:
+    """fnmatch-style exclusion against the display (repo-relative) path; a
+    bare directory pattern excludes everything under it."""
+    import fnmatch
+
+    display = display.replace(os.sep, "/")
+    for pattern in exclude:
+        pattern = pattern.rstrip("/").replace(os.sep, "/")
+        if fnmatch.fnmatch(display, pattern) or fnmatch.fnmatch(
+            display, pattern + "/*"
+        ):
+            return True
+    return False
+
+
 def iter_python_files(paths: Iterable[str]) -> List[str]:
-    out: List[str] = []
+    return [path for path, _ in _iter_python_files_with_origin(paths)]
+
+
+def _iter_python_files_with_origin(
+    paths: Iterable[str],
+) -> List[Tuple[str, bool]]:
+    """(path, explicit) pairs: explicitly-named files are marked so exclusion
+    patterns (which exist to keep fixture dirs out of directory sweeps) never
+    veto a file the caller asked for by name."""
+    out: List[Tuple[str, bool]] = []
     for path in paths:
         if os.path.isfile(path):
-            out.append(path)
+            out.append((path, True))
             continue
         for dirpath, dirnames, filenames in os.walk(path):
             dirnames[:] = sorted(d for d in dirnames if d not in _SKIP_DIRS)
             for name in sorted(filenames):
                 if name.endswith(".py"):
-                    out.append(os.path.join(dirpath, name))
+                    out.append((os.path.join(dirpath, name), False))
     return out
 
 
-def load_project(paths: Iterable[str], root: Optional[str] = None) -> Project:
+def load_project(
+    paths: Iterable[str],
+    root: Optional[str] = None,
+    exclude: Sequence[str] = (),
+) -> Project:
     root = root or os.getcwd()
     files = []
-    for path in iter_python_files(paths):
+    for path, explicit in _iter_python_files_with_origin(paths):
         display = os.path.relpath(path, root)
         if display.startswith(".."):
             display = path
+        if exclude and not explicit and _excluded(display, exclude):
+            continue
         try:
             with open(path, encoding="utf-8", errors="replace") as f:
                 text = f.read()

@@ -1,0 +1,374 @@
+"""Package-wide lock-object resolution, shared by the concurrency rules
+(``lock-order``, ``blocking-under-lock``).
+
+Python has no static lock types, so the model is built from the package's own
+idioms:
+
+- **self-attr locks** — ``self.X = threading.Lock()/RLock()/Semaphore()`` (or
+  the sanitizer's ``named_lock(...)`` wrapper) anywhere in a class body
+  registers lock ``<module>.<Class>.X``;
+- **module globals** — ``_lib_lock = threading.Lock()`` at module top level
+  registers ``<module>.<name>`` (resolution of a bare name is module-local:
+  two modules' ``_lock`` globals are distinct locks);
+- **Condition aliasing** — ``self.C = threading.Condition(self.X)`` makes
+  ``self.C`` the SAME lock node as ``self.X`` (a Condition over a lock *is*
+  that mutex: the head's ``actor_state_cond`` wraps ``head.lock``). A bare
+  ``Condition()`` is its own lock.
+
+Resolution of a ``with <expr>`` / annotation spec:
+
+- ``self.X`` inside class ``C`` → ``C``'s lock ``X`` if ``C`` declares one,
+  else the unique declaring class if exactly one class in the package has a
+  lock attr ``X`` (inheritance);
+- bare ``NAME`` → the current module's global lock ``NAME``;
+- ``obj.X`` (non-self) → the unique declaring class's ``X``; ambiguous attr
+  names (``_lock`` exists on several classes) resolve to nothing —
+  under-reporting beats mis-attributing an edge.
+
+``# guarded-by: <lock> held`` def annotations (the PR 4 vocabulary) mark a
+function's entry held-set; alternates (``lockA|lockB``) resolve each part and
+usually collapse to one node via Condition aliasing.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import Project, SourceFile, dotted_name
+from tools.analyze.rules.guarded_by import _annotations
+
+_LOCK_CTOR_SUFFIXES = ("Lock", "RLock", "Semaphore", "BoundedSemaphore")
+_WRAPPER_NAMES = ("named_lock",)
+
+
+def module_of(src: SourceFile) -> str:
+    """Module key from the FULL repo-relative path, not the basename: the
+    repo has obs/metrics.py AND estimator/metrics.py (and many __init__.py),
+    and a basename key would fuse their lock namespaces — a global named
+    ``_lock`` in one would resolve against the other's."""
+    path = src.display_path
+    if path.endswith(".py"):
+        path = path[: -len(".py")]
+    return path.replace(os.sep, ".").replace("/", ".").lstrip(".")
+
+
+def _is_lock_ctor(value: ast.AST) -> bool:
+    """Is this expression a lock constructor (incl. the named_lock wrapper)?"""
+    if not isinstance(value, ast.Call):
+        return False
+    name = dotted_name(value.func)
+    if name is None:
+        return False
+    terminal = name.split(".")[-1]
+    if terminal in _LOCK_CTOR_SUFFIXES:
+        return True
+    if terminal in _WRAPPER_NAMES:
+        return True
+    return False
+
+
+def _condition_target(value: ast.AST) -> Optional[ast.AST]:
+    """For ``threading.Condition(<lock-expr>)`` return the lock expr."""
+    if not isinstance(value, ast.Call):
+        return None
+    name = dotted_name(value.func)
+    if name is None or name.split(".")[-1] != "Condition":
+        return None
+    if value.args:
+        return value.args[0]
+    return None
+
+
+class LockModel:
+    """Lock identities + aliases discovered across the whole project."""
+
+    def __init__(self, project: Project):
+        # (module, class, attr) -> canonical id;  (module, name) -> canonical
+        self._class_attrs: Dict[Tuple[str, str], Dict[str, str]] = {}
+        self._globals: Dict[Tuple[str, str], str] = {}
+        self._attr_owners: Dict[str, Set[str]] = {}  # attr -> {canonical}
+        self._alias: Dict[str, str] = {}  # canonical -> canonical
+        self._discover(project)
+
+    # ---------- discovery ----------
+
+    def _discover(self, project: Project) -> None:
+        pending_aliases: List[Tuple[str, str, str, ast.AST, Optional[str]]] = []
+        for src in project:
+            if src.tree is None:
+                continue
+            module = module_of(src)
+            for stmt in src.tree.body:
+                targets = _assign_targets(stmt)
+                for target, value in targets:
+                    if not isinstance(target, ast.Name):
+                        continue
+                    if _is_lock_ctor(value) or _wraps_lock_ctor(value):
+                        self._globals[(module, target.id)] = f"{module}.{target.id}"
+                    cond = _condition_target(value)
+                    if cond is not None:
+                        pending_aliases.append(
+                            (module, "", target.id, cond, None)
+                        )
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.ClassDef):
+                    continue
+                attrs = self._class_attrs.setdefault((module, node.name), {})
+                for sub in ast.walk(node):
+                    for target, value in _assign_targets(sub):
+                        if not (
+                            isinstance(target, ast.Attribute)
+                            and isinstance(target.value, ast.Name)
+                            and target.value.id == "self"
+                        ):
+                            continue
+                        if _is_lock_ctor(value) or _wraps_lock_ctor(value):
+                            canonical = f"{module}.{node.name}.{target.attr}"
+                            attrs[target.attr] = canonical
+                            self._attr_owners.setdefault(
+                                target.attr, set()
+                            ).add(canonical)
+                        cond = _condition_target(value)
+                        if cond is not None:
+                            pending_aliases.append(
+                                (module, node.name, target.attr, cond, None)
+                            )
+        # resolve Condition aliases now every plain lock is known
+        for module, cls, attr, cond_expr, _ in pending_aliases:
+            target = self.resolve(cond_expr, cls or None, module)
+            if target is None:
+                continue  # Condition over an unknown lock: its own node
+            if cls:
+                canonical = f"{module}.{cls}.{attr}"
+                self._class_attrs.setdefault((module, cls), {})[attr] = canonical
+                self._attr_owners.setdefault(attr, set()).add(canonical)
+            else:
+                canonical = f"{module}.{attr}"
+                self._globals[(module, attr)] = canonical
+            self._alias[canonical] = self._canon(target)
+
+    # ---------- resolution ----------
+
+    def _canon(self, lock_id: str) -> str:
+        seen = set()
+        while lock_id in self._alias and lock_id not in seen:
+            seen.add(lock_id)
+            lock_id = self._alias[lock_id]
+        return lock_id
+
+    def _unique_attr(self, attr: str) -> Optional[str]:
+        owners = self._attr_owners.get(attr)
+        if owners is not None and len(owners) == 1:
+            return self._canon(next(iter(owners)))
+        return None
+
+    def resolve(
+        self,
+        expr_or_name,
+        class_name: Optional[str],
+        module: str,
+    ) -> Optional[str]:
+        """Canonical lock id for a ``with``-expression / annotation part, or
+        None when it does not resolve to a known lock."""
+        if isinstance(expr_or_name, str):
+            name = expr_or_name
+        else:
+            name = dotted_name(expr_or_name)
+        if not name:
+            return None
+        parts = name.split(".")
+        if parts[0] == "self" and len(parts) == 2 and class_name:
+            attrs = self._class_attrs.get((module, class_name), {})
+            if parts[1] in attrs:
+                return self._canon(attrs[parts[1]])
+            return self._unique_attr(parts[1])
+        if len(parts) == 1:
+            canonical = self._globals.get((module, parts[0]))
+            return self._canon(canonical) if canonical else None
+        # obj.attr / pkg.mod.attr: attribute name must be unambiguous
+        return self._unique_attr(parts[-1])
+
+    def resolve_spec(
+        self, spec: str, class_name: Optional[str], module: str
+    ) -> Set[str]:
+        """Resolve a guarded-by spec (``self.lock|self.actor_state_cond``)."""
+        out: Set[str] = set()
+        for part in spec.split("|"):
+            part = part.strip()
+            if not part:
+                continue
+            resolved = self.resolve(part, class_name, module)
+            if resolved is not None:
+                out.add(resolved)
+        return out
+
+
+def _assign_targets(stmt: ast.AST) -> List[Tuple[ast.AST, ast.AST]]:
+    if isinstance(stmt, ast.Assign) and stmt.value is not None:
+        return [(t, stmt.value) for t in stmt.targets]
+    if isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+        return [(stmt.target, stmt.value)]
+    return []
+
+
+def _wraps_lock_ctor(value: ast.AST) -> bool:
+    """``named_lock("name", threading.RLock())`` — the wrapper itself already
+    counts, but also accept any call whose ARGUMENT is a lock ctor (e.g. a
+    future wrapper the model does not know by name)."""
+    if not isinstance(value, ast.Call):
+        return False
+    return any(_is_lock_ctor(arg) for arg in value.args)
+
+
+def entry_held(
+    func: ast.AST,
+    annotations: Dict[int, Tuple[str, bool]],
+    model: LockModel,
+    class_name: Optional[str],
+    module: str,
+    src: SourceFile,
+) -> List[Tuple[str, str]]:
+    """(canonical, site description) entries a function holds on entry, per
+    its ``# guarded-by: <lock> held`` annotation."""
+    annot = annotations.get(getattr(func, "lineno", -1))
+    if annot is None or not annot[1]:
+        return []
+    held = []
+    for canonical in sorted(model.resolve_spec(annot[0], class_name, module)):
+        held.append(
+            (
+                canonical,
+                f"held on entry to {getattr(func, 'name', '<lambda>')} "
+                f"({src.display_path}:{func.lineno}, guarded-by annotation)",
+            )
+        )
+    return held
+
+
+def get_lock_model(project: Project) -> LockModel:
+    """One LockModel per project: both concurrency rules need it, and the
+    discovery pass walks every file's AST — build it once, cache it on the
+    project object."""
+    model = getattr(project, "_lock_model", None)
+    if model is None:
+        model = LockModel(project)
+        project._lock_model = model  # type: ignore[attr-defined]
+    return model
+
+
+class HeldStackWalker(ast.NodeVisitor):
+    """Shared held-stack maintenance for the concurrency rules: resolves
+    each ``with`` item to a lock, skips reentrant re-acquisition (RLock /
+    Condition alias already in the held set), pushes for the body and pops
+    after, and RESETS the context inside nested defs/lambdas (closures run
+    later, possibly on another thread — only their own ``... held``
+    annotation seeds their entry set). Items of one ``with a, b:`` enter
+    sequentially, so item *i*'s context expression is visited (and its lock
+    ordered) with items ``< i`` already held.
+
+    Subclasses implement ``_clone(func_name, held)`` (a fresh walker for a
+    nested scope) and hook ``on_acquire(canonical, node)``, called once per
+    NEWLY-acquired lock with ``self.held`` reflecting everything held at
+    that moment."""
+
+    def __init__(
+        self,
+        src: SourceFile,
+        model: LockModel,
+        annotations: Dict[int, Tuple[str, bool]],
+        class_name: Optional[str],
+        module: str,
+        func_name: str,
+        held: List[Tuple[str, str]],
+    ):
+        self.src = src
+        self.model = model
+        self.annotations = annotations
+        self.class_name = class_name
+        self.module = module
+        self.func_name = func_name
+        self.held = held  # [(canonical, acquisition-site description)]
+
+    # ---- subclass hooks ----
+
+    def on_acquire(self, canonical: str, node: ast.With) -> None:
+        """Called for each newly-acquired lock, before it joins self.held."""
+
+    def _clone(self, func_name: str, held: List[Tuple[str, str]]):
+        raise NotImplementedError
+
+    # ---- shared walking ----
+
+    def _acquire_site(self, node: ast.AST) -> str:
+        return (
+            f"acquired at {self.src.display_path}:{node.lineno} "
+            f"in {self.func_name}"
+        )
+
+    def visit_With(self, node: ast.With) -> None:
+        pushed = 0
+        for item in node.items:
+            # evaluated while earlier items' locks are already held
+            self.visit(item.context_expr)
+            canonical = self.model.resolve(
+                item.context_expr, self.class_name, self.module
+            )
+            if canonical is None or any(
+                h[0] == canonical for h in self.held
+            ):
+                # unknown lock, or reentrant re-acquisition (RLock /
+                # Condition alias): no new ordering information
+                continue
+            self.on_acquire(canonical, node)
+            self.held.append((canonical, self._acquire_site(node)))
+            pushed += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        if pushed:
+            del self.held[-pushed:]
+
+    visit_AsyncWith = visit_With
+
+    def _enter_nested(self, node) -> None:
+        inner_held = entry_held(
+            node, self.annotations, self.model, self.class_name,
+            self.module, self.src,
+        )
+        inner = self._clone(getattr(node, "name", "<lambda>"), inner_held)
+        body = node.body if isinstance(node.body, list) else [node.body]
+        for stmt in body:
+            inner.visit(stmt)
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._enter_nested(node)
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        inner = self._clone("<lambda>", [])
+        inner.visit(node.body)
+
+
+def iter_class_functions(tree: ast.AST):
+    """Yield (class_name_or_None, funcdef) for every top-level function and
+    every method, attributing methods to their class."""
+    for node in tree.body:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield None, node
+        elif isinstance(node, ast.ClassDef):
+            for sub in node.body:
+                if isinstance(sub, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    yield node.name, sub
+
+
+__all__ = [
+    "LockModel",
+    "get_lock_model",
+    "HeldStackWalker",
+    "module_of",
+    "entry_held",
+    "iter_class_functions",
+    "_annotations",
+]

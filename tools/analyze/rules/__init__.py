@@ -1,8 +1,10 @@
 """Rule registry. Adding a checker = one module with a Rule class + one
 import line here (see docs/analysis.md "Adding a checker")."""
 
+from tools.analyze.rules.blocking_under_lock import BlockingUnderLockRule
 from tools.analyze.rules.donation_aliasing import DonationAliasingRule
 from tools.analyze.rules.guarded_by import GuardedByRule
+from tools.analyze.rules.lock_order import LockOrderRule
 from tools.analyze.rules.print_diagnostics import PrintDiagnosticsRule
 from tools.analyze.rules.rpc_protocol import RpcProtocolRule
 from tools.analyze.rules.swallowed_exceptions import SwallowedExceptionsRule
@@ -12,6 +14,8 @@ ALL_RULES = (
     RpcProtocolRule,
     SwallowedExceptionsRule,
     GuardedByRule,
+    LockOrderRule,
+    BlockingUnderLockRule,
     PrintDiagnosticsRule,
 )
 

@@ -1,0 +1,144 @@
+"""blocking-under-lock: blocking operations executed while a lock is held.
+
+The head SERVES pooled control-plane RPC and ISSUES RPCs; holding its lock
+across a blocking call turns one slow peer into a frozen control plane — and
+two processes doing it to each other is a distributed deadlock no
+single-process lock graph can see. The rule flags, while any known lock is
+held (lexically, or via a ``# guarded-by: <lock> held`` annotation):
+
+- control-plane RPCs: ``rpc(...)``, ``rpc_pooled(...)``, ``head_rpc(...)``;
+- socket sends/receives (``.sendall``/``.sendto``/``.recv``/``.recv_into``/
+  ``.recvfrom``/``.accept``);
+- subprocess waits: ``subprocess.run/call/check_output/check_call``,
+  ``.communicate(...)``;
+- ``time.sleep(...)``;
+- unbounded ``.wait()`` / ``.join()`` (no timeout — a lost notify parks the
+  holder forever; Condition.wait() releases its OWN lock but an unbounded
+  one still hangs the caller, and any OTHER held lock stays held);
+- future ``.result(...)`` (an actor-call round trip);
+- jax host synchronization: ``block_until_ready``/``device_get``
+  (seconds-long device syncs).
+
+Fix by moving the call off-lock (snapshot state under the lock, block
+outside — see ``Head._unlink_objects``); suppress only with reasoning that
+shows the blocking path takes no other lock and the hold is deliberate.
+Lock identities resolve exactly as in ``lock-order`` (tools/analyze/locks).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Tuple
+
+from tools.analyze.core import Finding, Project, call_name
+from tools.analyze.locks import (
+    HeldStackWalker,
+    _annotations,
+    entry_held,
+    get_lock_model,
+    iter_class_functions,
+    module_of,
+)
+
+_RPC_NAMES = {"rpc", "rpc_pooled", "head_rpc"}
+_SOCKET_ATTRS = {"sendall", "sendto", "recv", "recv_into", "recvfrom", "accept"}
+_SUBPROCESS_TERMINALS = {"communicate"}
+_SUBPROCESS_DOTTED = {"run", "call", "check_output", "check_call"}
+_JAX_BLOCKING = {"block_until_ready", "device_get"}
+
+
+def _classify(node: ast.Call) -> Optional[str]:
+    """A human-readable description of the blocking op, or None."""
+    name = call_name(node)
+    terminal = name.split(".")[-1] if name else None
+    attr = node.func.attr if isinstance(node.func, ast.Attribute) else None
+    no_args = not node.args and not node.keywords
+    if terminal in _RPC_NAMES:
+        return f"control-plane RPC '{terminal}(...)'"
+    if attr in _SOCKET_ATTRS:
+        return f"socket '.{attr}(...)'"
+    if attr in _SUBPROCESS_TERMINALS:
+        return f"subprocess '.{attr}(...)'"
+    if (
+        name
+        and terminal in _SUBPROCESS_DOTTED
+        and len(name.split(".")) >= 2
+        and name.split(".")[-2] == "subprocess"
+    ):
+        return f"'{name}(...)'"
+    if terminal == "sleep" and (name in ("time.sleep", "sleep")):
+        return "'time.sleep(...)'"
+    if attr == "wait" and no_args:
+        return "unbounded '.wait()' (no timeout: a lost notify hangs forever)"
+    if attr == "join" and no_args:
+        return "unbounded '.join()' (no timeout)"
+    if attr == "result":
+        return "future '.result(...)' (actor-call round trip)"
+    if terminal in _JAX_BLOCKING:
+        return f"jax '{terminal}(...)' (host-device sync)"
+    return None
+
+
+class _BlockWalker(HeldStackWalker):
+    """Flag classified blocking calls while self.held is non-empty. The
+    held-stack maintenance lives in HeldStackWalker."""
+
+    def __init__(self, rule, src, model, annotations, class_name, module,
+                 func_name, held, findings):
+        super().__init__(
+            src, model, annotations, class_name, module, func_name, held
+        )
+        self.rule = rule
+        self.findings = findings
+
+    def _clone(self, func_name, held):
+        return _BlockWalker(
+            self.rule, self.src, self.model, self.annotations,
+            self.class_name, self.module, func_name, held, self.findings,
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held:
+            desc = _classify(node)
+            if desc is not None:
+                locks = ", ".join(
+                    f"'{name}' ({site})" for name, site in self.held
+                )
+                self.findings.append(
+                    self.src.finding(
+                        self.rule.name,
+                        node,
+                        f"blocking {desc} in {self.func_name} while holding "
+                        f"{locks} — move it off-lock (snapshot state under "
+                        "the lock, block outside) or suppress with the "
+                        "reasoning that makes the hold safe",
+                    )
+                )
+        self.generic_visit(node)
+
+
+class BlockingUnderLockRule:
+    """Blocking calls (RPC, sleep, unbounded wait/join, subprocess, jax
+    sync) made while a known lock is held."""
+
+    name = "blocking-under-lock"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        model = get_lock_model(project)
+        for src in project:
+            if src.tree is None:
+                continue
+            annotations = _annotations(src)
+            module = module_of(src)
+            for class_name, func in iter_class_functions(src.tree):
+                held = entry_held(
+                    func, annotations, model, class_name, module, src
+                )
+                walker = _BlockWalker(
+                    self, src, model, annotations, class_name, module,
+                    func.name, held, findings,
+                )
+                for stmt in func.body:
+                    walker.visit(stmt)
+        return findings

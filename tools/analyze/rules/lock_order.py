@@ -1,0 +1,222 @@
+"""lock-order: lock-acquisition-order cycles (potential deadlocks).
+
+The control plane holds 13+ locks and the head both SERVES pooled RPC and
+ISSUES RPCs — the classic environment for lock-inversion deadlocks that no
+test catches (the interleaving that deadlocks is the one CI never runs).
+This rule builds the package-wide lock-acquisition order graph and flags any
+cycle, with BOTH acquisition paths in the finding:
+
+- lock identities resolve package-wide (self-attr locks, module globals,
+  ``Condition``-wrapping pairs like ``head.actor_state_cond``/``head.lock``
+  collapse to one node) — see tools/analyze/locks.py;
+- edges come from lexical ``with <lockA>: ... with <lockB>`` nesting, plus
+  interprocedural entry edges through ``# guarded-by: <lock> held``
+  annotated functions (the function body acquires under the caller's lock);
+- a pair of functions acquiring the same two locks in opposite orders is a
+  2-cycle and reported with both sites; longer cycles are reported once per
+  strongly-connected component with the full edge list.
+
+The runtime counterpart is ``RAYDP_TPU_SANITIZE=lockdep``
+(raydp_tpu/sanitize.py), which catches orders the static net cannot see
+(locks passed through data structures, dynamic dispatch).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import Finding, Project
+from tools.analyze.locks import (
+    HeldStackWalker,
+    _annotations,
+    entry_held,
+    get_lock_model,
+    iter_class_functions,
+    module_of,
+)
+
+
+class _Edge:
+    __slots__ = ("src", "node", "func", "holder_site", "acquire_site")
+
+    def __init__(self, src, node, func, holder_site, acquire_site):
+        self.src = src
+        self.node = node  # AST node of the inner acquisition (anchor)
+        self.func = func
+        self.holder_site = holder_site
+        self.acquire_site = acquire_site
+
+    def describe(self, a: str, b: str) -> str:
+        return (
+            f"{a} -> {b} {self.acquire_site} "
+            f"(outer lock {self.holder_site})"
+        )
+
+
+class _AcqWalker(HeldStackWalker):
+    """Collect (held -> acquired) edges from one function body. The held
+    stack, reentrancy skip, multi-item `with a, b:` sequencing, and nested
+    def/lambda context reset all live in HeldStackWalker."""
+
+    def __init__(self, rule, src, model, annotations, class_name, module,
+                 func_name, held):
+        super().__init__(
+            src, model, annotations, class_name, module, func_name, held
+        )
+        self.rule = rule
+
+    def _clone(self, func_name, held):
+        return _AcqWalker(
+            self.rule, self.src, self.model, self.annotations,
+            self.class_name, self.module, func_name, held,
+        )
+
+    def on_acquire(self, canonical: str, node: ast.With) -> None:
+        for holder, holder_site in self.held:
+            self.rule.add_edge(
+                holder,
+                canonical,
+                _Edge(
+                    self.src, node, self.func_name, holder_site,
+                    self._acquire_site(node),
+                ),
+            )
+
+
+class LockOrderRule:
+    """Cycles in the package-wide lock-acquisition order graph."""
+
+    name = "lock-order"
+
+    def __init__(self):
+        self.edges: Dict[Tuple[str, str], _Edge] = {}
+
+    def add_edge(self, a: str, b: str, edge: _Edge) -> None:
+        self.edges.setdefault((a, b), edge)  # first site wins (deterministic)
+
+    def check_project(self, project: Project) -> List[Finding]:
+        self.edges = {}
+        model = get_lock_model(project)
+        for src in project:
+            if src.tree is None:
+                continue
+            annotations = _annotations(src)
+            module = module_of(src)
+            for class_name, func in iter_class_functions(src.tree):
+                held = entry_held(
+                    func, annotations, model, class_name, module, src
+                )
+                walker = _AcqWalker(
+                    self, src, model, annotations, class_name, module,
+                    func.name, held,
+                )
+                for stmt in func.body:
+                    walker.visit(stmt)
+        return self._findings()
+
+    # ---------- cycle detection ----------
+
+    def _findings(self) -> List[Finding]:
+        findings: List[Finding] = []
+        reported_pairs: Set[Tuple[str, str]] = set()
+        # 2-cycles: the same two locks taken in opposite orders
+        for (a, b) in sorted(self.edges):
+            if (b, a) not in self.edges or (b, a) in reported_pairs:
+                continue
+            reported_pairs.add((a, b))
+            fwd, rev = self.edges[(a, b)], self.edges[(b, a)]
+            anchor = min(
+                (fwd, rev), key=lambda e: (e.src.display_path, e.node.lineno)
+            )
+            findings.append(
+                anchor.src.finding(
+                    self.name,
+                    anchor.node,
+                    f"lock-order inversion between '{a}' and '{b}' "
+                    f"(potential deadlock): {fwd.describe(a, b)}; "
+                    f"{rev.describe(b, a)} — flip one order, or suppress "
+                    "with the reasoning that proves both paths can never "
+                    "contend",
+                )
+            )
+        # longer cycles: SCCs not already explained by a reported 2-cycle
+        for scc in self._sccs():
+            if len(scc) < 3:
+                continue
+            scc_set = set(scc)
+            if any(
+                a in scc_set and b in scc_set for (a, b) in reported_pairs
+            ):
+                continue
+            cycle_edges = [
+                (a, b) for (a, b) in sorted(self.edges)
+                if a in scc_set and b in scc_set
+            ]
+            anchor = self.edges[cycle_edges[0]]
+            path = "; ".join(
+                self.edges[(a, b)].describe(a, b) for (a, b) in cycle_edges
+            )
+            findings.append(
+                anchor.src.finding(
+                    self.name,
+                    anchor.node,
+                    f"lock-order cycle across {len(scc)} locks "
+                    f"({' -> '.join(sorted(scc_set))}) — potential deadlock: "
+                    f"{path}",
+                )
+            )
+        return findings
+
+    def _sccs(self) -> List[List[str]]:
+        """Tarjan SCCs over the acquisition graph (iterative)."""
+        adj: Dict[str, List[str]] = {}
+        for (a, b) in self.edges:
+            adj.setdefault(a, []).append(b)
+            adj.setdefault(b, [])
+        index: Dict[str, int] = {}
+        low: Dict[str, int] = {}
+        on_stack: Set[str] = set()
+        stack: List[str] = []
+        sccs: List[List[str]] = []
+        counter = [0]
+
+        for root in sorted(adj):
+            if root in index:
+                continue
+            work = [(root, iter(adj[root]))]
+            index[root] = low[root] = counter[0]
+            counter[0] += 1
+            stack.append(root)
+            on_stack.add(root)
+            while work:
+                node, it = work[-1]
+                advanced = False
+                for nxt in it:
+                    if nxt not in index:
+                        index[nxt] = low[nxt] = counter[0]
+                        counter[0] += 1
+                        stack.append(nxt)
+                        on_stack.add(nxt)
+                        work.append((nxt, iter(adj[nxt])))
+                        advanced = True
+                        break
+                    if nxt in on_stack:
+                        low[node] = min(low[node], index[nxt])
+                if advanced:
+                    continue
+                work.pop()
+                if work:
+                    parent = work[-1][0]
+                    low[parent] = min(low[parent], low[node])
+                if low[node] == index[node]:
+                    scc = []
+                    while True:
+                        popped = stack.pop()
+                        on_stack.discard(popped)
+                        scc.append(popped)
+                        if popped == node:
+                            break
+                    if len(scc) > 1:
+                        sccs.append(scc)
+        return sccs

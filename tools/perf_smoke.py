@@ -15,6 +15,7 @@ when:
 
 Usage: ``python tools/perf_smoke.py [artifact.json]``
 """
+# raydp-lint: disable-file=print-diagnostics (standalone CI tool: its stdout IS the report, there is no obs role to tag)
 
 from __future__ import annotations
 
