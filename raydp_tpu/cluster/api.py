@@ -140,14 +140,34 @@ def init(
             start_zygote(_session_dir, env=head_env)
         except Exception:  # raydp-lint: disable=swallowed-exceptions (eager warm-up only; the head starts one at boot)
             pass  # the head will start one at boot
-        # -S: skip site/sitecustomize (this image's sitecustomize imports jax
-        # + the TPU plugin — ~2.6s the head never needs); imports resolve via
-        # the PYTHONPATH above
-        _head_proc = subprocess.Popen(
-            [sys.executable, "-S", "-m", "raydp_tpu.cluster.head_main", _session_dir],
-            start_new_session=True,
-            env=head_env,
-        )
+        # warm boot: fork the head from the pre-warmed zygote when a READY
+        # template exists (second-and-later sessions on a machine — the
+        # global template survives across clusters): head boot becomes a
+        # ~10ms fork with imports inherited copy-on-write, the dominant
+        # term of sub-100ms warm cluster_boot_s. Cold machines fall through
+        # to the subprocess start immediately (no warm-up wait).
+        _head_proc = None
+        try:
+            from raydp_tpu.cluster.common import zygote_fork_main
+
+            _head_proc = zygote_fork_main(
+                _session_dir,
+                "raydp_tpu.cluster.head_main",
+                [_session_dir],
+                head_env,
+                os.path.join(_session_dir, "head"),
+            )
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (warm boot is opportunistic; the cold start below always works)
+            _head_proc = None
+        if _head_proc is None:
+            # -S: skip site/sitecustomize (this image's sitecustomize
+            # imports jax + the TPU plugin — ~2.6s the head never needs);
+            # imports resolve via the PYTHONPATH above
+            _head_proc = subprocess.Popen(
+                [sys.executable, "-S", "-m", "raydp_tpu.cluster.head_main", _session_dir],
+                start_new_session=True,
+                env=head_env,
+            )
         wait_for_path(head_sock_path(_session_dir), 30, "head socket")
         # adopt the cluster token into the environment so this process (and
         # every subprocess it starts — agents, SPMD launchers) can
@@ -314,10 +334,95 @@ def shutdown() -> None:
     from raydp_tpu.cluster.common import close_pooled_connections
 
     close_pooled_connections()
+    close_actor_connections()  # doorbell sockets join the fd audit too
     _sanitize.audit_leaks("cluster.shutdown")
 
 
 # ---------- actors ----------
+
+# ---------------------------------------------------------------------------
+# doorbell: persistent per-(thread, actor-socket) dispatch connections
+#
+# Actor method calls used to open a fresh socket per call (ActorFuture closed
+# it after the reply) — a connect + accept-thread round per dispatch, ~ms on
+# the interactive-query hot path. The doorbell keeps the socket: a completed
+# future returns its connection to the calling thread's pool, and the next
+# dispatch to that actor reuses it (one outstanding request per pooled
+# connection; concurrent sends to one actor from one thread fall back to
+# fresh sockets). SAME-HOST (Unix sockets) ONLY: a stale UDS failing at SEND
+# was never delivered (peer-closed stream sockets fail the first write), so
+# retrying on a fresh socket is safe — the same contract rpc_pooled has; on
+# TCP a send into a dead peer succeeds until the RST arrives, so tcp://
+# actors keep per-call sockets. Toggles: RAYDP_TPU_NO_DOORBELL=1 (process)
+# or the ``cluster.doorbell`` session conf via set_doorbell(). Shutdown
+# closes the calling thread's doorbell sockets so the leak sanitizer's fd
+# audit stays clean.
+# ---------------------------------------------------------------------------
+
+_doorbell_tls = threading.local()
+_DOORBELL_MAX = 16  # dead sessions' executor sockets must not pile up
+_doorbell_on = True  # process-wide toggle; bool writes are atomic
+
+
+def _doorbell_enabled() -> bool:
+    return _doorbell_on and os.environ.get("RAYDP_TPU_NO_DOORBELL") != "1"
+
+
+def set_doorbell(enabled: bool) -> None:
+    """Process-wide toggle (the ``cluster.doorbell`` session conf): off =
+    one fresh socket per actor call, the pre-doorbell behavior."""
+    global _doorbell_on
+    _doorbell_on = bool(enabled)
+
+
+def _doorbell_take(sock_path: str):
+    conns = getattr(_doorbell_tls, "conns", None)
+    if conns is None:
+        return None
+    return conns.pop(sock_path, None)
+
+
+def _doorbell_release(sock_path: str, sock) -> None:
+    if not _doorbell_enabled():
+        try:
+            sock.close()
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (closing a possibly-dead doorbell socket)
+            pass
+        return
+    conns = getattr(_doorbell_tls, "conns", None)
+    if conns is None:
+        conns = _doorbell_tls.conns = {}
+    old = conns.pop(sock_path, None)
+    if old is not None:
+        try:
+            old.close()
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (closing a displaced doorbell socket)
+            pass
+    while len(conns) >= _DOORBELL_MAX:
+        # evict the OLDEST entry (insertion order): dead sessions' sockets
+        # age out while the hot actors' connections stay pooled
+        oldest = next(iter(conns))
+        victim = conns.pop(oldest)
+        try:
+            victim.close()
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (closing an evicted doorbell socket)
+            pass
+    conns[sock_path] = sock
+
+
+def close_actor_connections() -> None:
+    """Close THIS thread's doorbell sockets (shutdown hygiene, mirroring
+    ``common.close_pooled_connections`` for the head pool: the fd audit in
+    the leak sanitizer counts lingering sockets against the baseline)."""
+    conns = getattr(_doorbell_tls, "conns", None)
+    if not conns:
+        return
+    for sock in list(conns.values()):
+        try:
+            sock.close()
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (closing a possibly-dead doorbell socket)
+            pass
+    conns.clear()
 
 
 class RemoteMethod:
@@ -345,9 +450,10 @@ class RemoteMethod:
 
 
 class ActorFuture:
-    def __init__(self, sock, timeout: Optional[float]):
+    def __init__(self, sock, timeout: Optional[float], pool_key: Optional[str] = None):
         self._sock = sock
         self._timeout = timeout
+        self._pool_key = pool_key  # doorbell: return the conn on completion
         self._done = False
         self._value: Any = None
         self._error: Optional[BaseException] = None
@@ -367,7 +473,13 @@ class ActorFuture:
                 self._sock.close()
                 self._done = True
                 raise
-            self._sock.close()
+            # reply fully consumed: the connection is stream-clean — return
+            # it to the doorbell pool so the next dispatch to this actor
+            # skips connect/accept/handshake entirely
+            if self._pool_key is not None:
+                _doorbell_release(self._pool_key, self._sock)
+            else:
+                self._sock.close()
             self._done = True
             if status == "ok":
                 self._value = value
@@ -475,24 +587,53 @@ class ActorHandle:
                   timeout: Optional[float]):
         """Connect-phase failures raise _ConnectFailed (request was never
         delivered, always safe to retry); send-phase failures propagate raw
-        (the actor may have partially received the request)."""
+        (the actor may have partially received the request). Dispatches ride
+        a pooled doorbell connection when one is free: a stale doorbell that
+        fails at SEND was never delivered (peer-closed stream sockets fail
+        the first write), so it silently falls through to a fresh connect."""
+        from raydp_tpu.cluster.common import traced_request
+
+        # the caller's trace context rides the frame so executor-side
+        # spans (task read/compute/emit) link under the driver's stage
+        frame = traced_request((method, args, kwargs, no_reply))
+        # UNIX sockets only: the stale-at-SEND-was-never-delivered retry
+        # premise holds for UDS (a peer-closed stream fails the first write)
+        # but NOT for TCP, where a send into a dead peer succeeds until the
+        # RST arrives — a pooled tcp:// dispatch could silently vanish
+        use_doorbell = _doorbell_enabled() and not sock_path.startswith("tcp://")
+        pooled = _doorbell_take(sock_path) if use_doorbell else None
+        if pooled is not None:
+            try:
+                pooled.settimeout(timeout or 300.0)
+                send_frame(pooled, frame)
+            except (ConnectionError, OSError):
+                try:
+                    pooled.close()
+                except OSError:  # raydp-lint: disable=swallowed-exceptions (closing the stale doorbell before the fresh connect)
+                    pass
+            else:
+                if no_reply:
+                    _doorbell_release(sock_path, pooled)
+                    return _CompletedFuture()
+                return ActorFuture(pooled, timeout, pool_key=sock_path)
         try:
             sock = connect(sock_path, timeout=timeout or 300.0)
         except (ConnectionError, FileNotFoundError, OSError) as exc:
             raise _ConnectFailed(str(exc)) from exc
         try:
-            from raydp_tpu.cluster.common import traced_request
-
-            # the caller's trace context rides the frame so executor-side
-            # spans (task read/compute/emit) link under the driver's stage
-            send_frame(sock, traced_request((method, args, kwargs, no_reply)))
+            send_frame(sock, frame)
         except BaseException:
             sock.close()
             raise
         if no_reply:
-            sock.close()
+            if use_doorbell:
+                _doorbell_release(sock_path, sock)
+            else:
+                sock.close()
             return _CompletedFuture()
-        return ActorFuture(sock, timeout)
+        return ActorFuture(
+            sock, timeout, pool_key=sock_path if use_doorbell else None
+        )
 
     def _call(self, method: str, args, kwargs, no_reply: bool, timeout: Optional[float],
               retries: int) -> ActorFuture:

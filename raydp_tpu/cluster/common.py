@@ -330,7 +330,9 @@ def wait_for_path(path: str, timeout: float, what: str) -> None:
     while not os.path.exists(path):
         if time.monotonic() > deadline:
             raise ClusterError(f"timed out waiting for {what} at {path}")
-        time.sleep(0.02)
+        # 5ms: this poll sits on the warm-boot critical path (head socket
+        # after a ~10ms zygote fork) — a 20ms granularity dominated it
+        time.sleep(0.005)
 
 
 @dataclasses.dataclass
@@ -473,6 +475,34 @@ class ZygoteProc:
         self.pid = pid
         self._log_base = log_base
         self._rc: Optional[int] = None
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        """Popen.wait parity over the poll shim (callers that treat head/
+        agent processes uniformly — api.shutdown — need it). Raises
+        subprocess.TimeoutExpired like the real thing."""
+        import subprocess
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            rc = self.poll()
+            if rc is not None:
+                return rc
+            if deadline is not None and time.monotonic() > deadline:
+                raise subprocess.TimeoutExpired("zygote-forked-process", timeout)
+            time.sleep(0.01)
+
+    def kill(self) -> None:
+        """SIGKILL the forked child's process group (it setsid() at birth,
+        so the group is exactly its own tree)."""
+        import signal as _signal
+
+        try:
+            os.killpg(self.pid, _signal.SIGKILL)
+        except (ProcessLookupError, PermissionError, OSError):
+            try:
+                os.kill(self.pid, _signal.SIGKILL)
+            except (ProcessLookupError, PermissionError, OSError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead process is idempotent)
+                pass
 
     def poll(self) -> Optional[int]:
         if self._rc is not None:
@@ -753,10 +783,11 @@ def _safe_getcwd(fallback: str) -> str:
         return fallback
 
 
-def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log_base: str):
-    """Request a fork from the node's zygote; None = unavailable (no marker,
-    dead zygote, or protocol failure) — the caller falls back to a cold
-    subprocess start."""
+def _zygote_request(run_dir: str, req: Dict[str, Any], wait_s: float = 15.0):
+    """Send one fork request to the node's zygote; the child pid, or None =
+    unavailable (no marker, dead zygote, protocol failure) — callers fall
+    back to a cold subprocess start. ``wait_s`` bounds how long to wait for
+    a zygote still warming its imports."""
     from raydp_tpu.cluster.zygote import zygote_marker_path, zygote_sock_path
 
     marker = zygote_marker_path(run_dir)
@@ -765,7 +796,7 @@ def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log
     sock_path = zygote_sock_path(run_dir)
     # the zygote may still be warming its imports; wait for the socket (its
     # warm-up started at node boot, so this is usually instant)
-    deadline = time.monotonic() + 15.0
+    deadline = time.monotonic() + wait_s
     while True:
         try:
             sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
@@ -780,25 +811,73 @@ def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log
                 return None  # died while warming
             time.sleep(0.02)
     try:
-        send_frame(
-            sock,
-            {
-                "run_dir": run_dir,
-                "actor_id": spec.actor_id,
-                "incarnation": incarnation,
-                "env": env,
-                "log_base": log_base,
-                # what a cold subprocess start would inherit — the global
-                # zygote's own cwd belongs to whichever driver started it
-                "cwd": _safe_getcwd(run_dir),
-            },
-        )
+        send_frame(sock, req)
         status, pid = recv_frame(sock)
     except (ConnectionError, OSError):
         return None
     finally:
         sock.close()
     if status != "ok":
+        return None
+    return pid
+
+
+def _zygote_spawn(spec, incarnation: int, run_dir: str, env: Dict[str, str], log_base: str):
+    """Request an actor-worker fork from the node's zygote; None = fall back
+    to a cold subprocess start."""
+    pid = _zygote_request(
+        run_dir,
+        {
+            "run_dir": run_dir,
+            "actor_id": spec.actor_id,
+            "incarnation": incarnation,
+            "env": env,
+            "log_base": log_base,
+            # what a cold subprocess start would inherit — the global
+            # zygote's own cwd belongs to whichever driver started it
+            "cwd": _safe_getcwd(run_dir),
+        },
+    )
+    if pid is None:
+        return None
+    return ZygoteProc(pid, log_base)
+
+
+def zygote_fork_main(
+    run_dir: str,
+    module: str,
+    argv: List[str],
+    env: Dict[str, str],
+    log_base: str,
+    wait_s: float = 2.0,
+):
+    """Fork a MODULE MAIN (head / agent entry point) from the pre-warmed
+    zygote: the warm-boot path that takes ``cluster_boot_s`` under 100ms on
+    a machine whose global template is already up — the head becomes a
+    ~10ms fork with its import set inherited copy-on-write, instead of a
+    cold ``python -S`` start. Returns a ZygoteProc, or None when no READY
+    template exists (absent or still warming — boot must fall back to the
+    cold start immediately rather than wait out the warm-up)."""
+    from raydp_tpu.cluster.zygote import zygote_sock_path
+
+    if not os.path.exists(zygote_sock_path(run_dir)):
+        # exists() follows the adoption symlink: a dangling link means the
+        # global template is still importing — cold start wins that race
+        return None
+    pid = _zygote_request(
+        run_dir,
+        {
+            "kind": "main",
+            "module": module,
+            "argv": list(argv),
+            "run_dir": run_dir,
+            "env": dict(env),
+            "log_base": log_base,
+            "cwd": _safe_getcwd(run_dir),
+        },
+        wait_s=wait_s,
+    )
+    if pid is None:
         return None
     return ZygoteProc(pid, log_base)
 

@@ -494,7 +494,45 @@ class Head:
         # has no head socket (and on another machine doesn't exist at all)
         env.setdefault(HEAD_ADDR_ENV, head_sock_path(self.session_dir))
 
-        actor.proc = launch_worker(spec, actor.incarnation, self.session_dir, env)
+        # the fork itself runs OFF the head lock on a thread: a zygote fork
+        # of a warmed template costs tens of ms on small boxes (page-table
+        # copy), and paying it synchronously under the lock serialized every
+        # create_actor behind it — the dominant term of session boot. The
+        # same deferred-proc discipline as agent spawns applies: proc lands
+        # under the lock when the fork completes, and a kill that raced the
+        # spawn reaps the fresh process the moment it is recorded.
+        incarnation = actor.incarnation
+
+        def _local_spawn():
+            try:
+                proc = launch_worker(spec, incarnation, self.session_dir, env)
+            except OSError:
+                with self.lock:
+                    if actor.incarnation == incarnation and actor.state not in (
+                        ActorState.DEAD,
+                        ActorState.ALIVE,
+                    ):
+                        self._release_actor_resources(actor)
+                        actor.pending_respawn = True
+                return
+            stale = False
+            with self.lock:
+                if (
+                    actor.incarnation != incarnation
+                    or actor.intentional_exit
+                    or actor.state == ActorState.DEAD
+                ):
+                    stale = True  # killed/fenced while forking
+                else:
+                    actor.proc = proc
+            if stale:
+                try:
+                    os.killpg(proc.pid, signal.SIGKILL)
+                except (ProcessLookupError, PermissionError, OSError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead raced spawn is idempotent)
+                    pass
+
+        threading.Thread(target=_local_spawn, daemon=True).start()
+        actor.proc = None
 
     def handle_create_actor(self, spec: ActorSpec) -> str:
         with self.lock:
@@ -659,6 +697,15 @@ class Head:
                 threading.Thread(
                     target=self._reap_after_kill, args=(actor,), daemon=True
                 ).start()
+            else:
+                node = self.nodes.get(actor.node_id) if actor.node_id else None
+                if node is None or node.agent_addr is None:
+                    # local actor whose async fork hasn't landed yet: there
+                    # is no process to reap (the spawn thread SIGKILLs the
+                    # raced fork when it records the kill) — run the death
+                    # bookkeeping now so state() drains to DEAD promptly
+                    if actor.state != ActorState.DEAD and not actor.pending_respawn:
+                        self._on_actor_death(actor)
             return True
 
     def _reap_after_kill(self, actor: "_Actor") -> None:
@@ -895,6 +942,32 @@ class Head:
                     None
                     if (meta := self.objects.get(oid)) is None
                     else self._meta_view(oid, meta)
+                )
+                for oid in object_ids
+            }
+
+    # how long a client may act on a served location without re-asking: the
+    # head-bypass contract (store.cached_location honors it; expired entries
+    # take the miss path back here)
+    LOCATION_LEASE_S = 120.0
+
+    def handle_object_lookup_lease(self, object_ids: List[str]):
+        """Vectorized lookup returning lease-stamped location records:
+        ``{object_id: meta-or-None}`` where each meta carries ``lease_s`` —
+        the head's promise that acting on the location for that long without
+        re-asking is safe (blocks never move; deletion/owner-death makes a
+        stale read FAIL, and the reader's fallback re-asks the head, which
+        is authoritative). The miss path of the executors' peer-to-peer
+        block resolution (store.lookup_many)."""
+        with self.lock:
+            return {
+                oid: (
+                    None
+                    if (meta := self.objects.get(oid)) is None
+                    else {
+                        **self._meta_view(oid, meta),
+                        "lease_s": self.LOCATION_LEASE_S,
+                    }
                 )
                 for oid in object_ids
             }

@@ -17,6 +17,15 @@ def main() -> None:
     from raydp_tpu.cluster.common import SESSION_ENV
 
     os.environ[SESSION_ENV] = session_dir
+    # a zygote-forked head inherits the TEMPLATE's tracing state and lock-
+    # order history; this session's env (delivered with the fork request)
+    # decides — same re-init dance the worker entry does
+    from raydp_tpu.obs.tracing import reinit_for_process
+
+    reinit_for_process("head")
+    from raydp_tpu import sanitize
+
+    sanitize.reset_lockdep()
     with open(os.path.join(session_dir, "head_boot.pkl"), "rb") as f:
         driver_pid, default_resources = cloudpickle.load(f)
     # the cluster's shared secret, written before any socket exists; the

@@ -140,15 +140,25 @@ def _become_worker(req: dict, conn: socket.socket) -> None:
         for entry in reversed(env.get("PYTHONPATH", "").split(os.pathsep)):
             if entry and entry not in sys.path:
                 sys.path.insert(0, entry)
-        sys.argv = [
-            "raydp_tpu-worker",
-            req["run_dir"],
-            req["actor_id"],
-            str(req["incarnation"]),
-        ]
-        from raydp_tpu.cluster import worker
+        if req.get("kind") == "main":
+            # pre-forked MODULE MAIN (head / agent entry): the child
+            # inherits the warmed import set and jumps straight into the
+            # module's main() — a head boot becomes a ~10ms fork instead of
+            # a cold `python -S` interpreter + import start
+            import importlib
 
-        worker.main()
+            sys.argv = [req["module"]] + [str(a) for a in req.get("argv", [])]
+            importlib.import_module(req["module"]).main()
+        else:
+            sys.argv = [
+                "raydp_tpu-worker",
+                req["run_dir"],
+                req["actor_id"],
+                str(req["incarnation"]),
+            ]
+            from raydp_tpu.cluster import worker
+
+            worker.main()
     except SystemExit:  # raydp-lint: disable=swallowed-exceptions (worker.main exits via SystemExit on clean shutdown)
         pass
     except BaseException:  # noqa: BLE001 - last-resort report to the log

@@ -18,12 +18,22 @@ from raydp_tpu.etl import tasks as T
 
 
 class EtlExecutor:
+    # executor-resident compiled programs (plans cached by fingerprint):
+    # warm run_plan dispatches carry only the binding, not the plan
+    PROGRAM_CACHE_CAP = 32
+
     def __init__(self, executor_id: int, app_name: str, configs: Optional[dict] = None):
         self.executor_id = executor_id
         self.app_name = app_name
         self.configs = dict(configs or {})
         self.cores = max(1, int(self.configs.get("etl.executor.cores", 1)))
         self._task_pool = None
+        import collections
+
+        from raydp_tpu.sanitize import named_lock
+
+        self._programs: "collections.OrderedDict" = collections.OrderedDict()  # guarded-by: self._programs_lock
+        self._programs_lock = named_lock("etl.executor.programs")
         # keep BLAS/arrow thread pools from oversubscribing the host: each
         # executor is sized by its CPU resource, not the whole machine
         os.environ.setdefault("OMP_NUM_THREADS", "1")
@@ -33,6 +43,15 @@ class EtlExecutor:
         # pools above are sized for resource-isolated executors)
         T.set_arrow_threads(
             str(self.configs.get("planner.arrow_threads", "false")).lower()
+            in ("1", "true", "yes")
+        )
+        # head-bypass parity: a session that turns the location cache off
+        # (A/B tests) must turn it off in the EXECUTOR processes too, or
+        # writer-side caching would still skip the head on the reduce path
+        from raydp_tpu.store import object_store as _store
+
+        _store.set_location_cache(
+            str(self.configs.get("planner.head_bypass", "true")).lower()
             in ("1", "true", "yes")
         )
         self._warm_up()
@@ -140,6 +159,21 @@ class EtlExecutor:
         self._ship_telemetry()
         return results
 
+    def _fanout(self, specs: List[T.TaskSpec]) -> List[T.TaskResult]:
+        """Run a spec list over the task pool (arrow kernels release the
+        GIL), propagating the dispatch RPC's trace context to pool threads
+        so task spans link under the driver's stage span."""
+        from raydp_tpu import obs
+
+        if len(specs) <= 1 or self.cores <= 1:
+            return [self._run_one(s) for s in specs]
+        ctx = obs.current_context()
+        return list(
+            self._pool().map(
+                lambda s: obs.with_context(ctx, self._run_one, s), specs
+            )
+        )
+
     def run_shuffle(
         self,
         map_specs: List[T.TaskSpec],
@@ -156,26 +190,44 @@ class EtlExecutor:
         read, filled here from the map results. Returns
         ``(map_results, reduce_results)`` — the driver still owns cleanup
         of the intermediate blocks."""
-        from raydp_tpu import obs
-
-        ctx = obs.current_context()
-
-        def _fanout(specs: List[T.TaskSpec]) -> List[T.TaskResult]:
-            if len(specs) <= 1 or self.cores <= 1:
-                return [self._run_one(s) for s in specs]
-            return list(
-                self._pool().map(
-                    lambda s: obs.with_context(ctx, self._run_one, s), specs
-                )
-            )
-
-        map_results = _fanout(map_specs)
+        map_results = self._fanout(map_specs)
         reads = T.build_shuffle_reads(map_results, num_reducers, schema_ipc)
         for r, proto in enumerate(reduce_protos):
             proto.reads = [reads[r]]
-        reduce_results = _fanout(reduce_protos)
+        reduce_results = self._fanout(reduce_protos)
         self._ship_telemetry()
         return map_results, reduce_results
+
+    def run_plan(self, program_id: str, binding: dict, program_blob=None):
+        """Whole-plan compiled dispatch: run a CompiledProgram — narrow
+        stage, or a full map→shuffle→reduce exchange — in ONE RPC. The
+        program body (``program_blob``, pre-pickled by the driver at
+        compile) ships only on first delivery; afterwards it is EXECUTOR-
+        RESIDENT, keyed by its plan fingerprint, and warm dispatches carry
+        just the binding (block refs, literal values, output owner).
+        Raises ``ProgramCacheMiss`` when asked to run an id this executor
+        no longer holds (LRU eviction / restart) — the driver re-sends the
+        body once."""
+        from raydp_tpu.etl import program as P
+
+        with self._programs_lock:
+            program = self._programs.get(program_id)
+            if program is not None:
+                self._programs.move_to_end(program_id)
+        if program is None:
+            if program_blob is None:
+                raise P.ProgramCacheMiss(program_id)
+            import cloudpickle
+
+            program = cloudpickle.loads(program_blob)
+            with self._programs_lock:
+                self._programs[program_id] = program
+                self._programs.move_to_end(program_id)
+                while len(self._programs) > self.PROGRAM_CACHE_CAP:
+                    self._programs.popitem(last=False)
+        result = P.execute_program(program, binding, self._fanout)
+        self._ship_telemetry()
+        return result
 
     # -- data plane (exchange layer reads, SURVEY.md §3.6 analog) --
 

@@ -64,6 +64,29 @@ class Planner:
         # executor_cores, matching the executor-side run_tasks thread pool);
         # sizes the reply-timeout budget of batched dispatches
         self.executor_slots = max(1, int(executor_slots))
+        # millisecond control plane (all default on; session confs flip
+        # them for A/B parity tests):
+        #   planner.plan_cache — fingerprint logical plans and cache the
+        #     lowered program so repeated query shapes skip planning/
+        #     lowering; literals and ArrowSource block refs are parameter
+        #     slots that rebind without recompilation
+        #   planner.compiled_dispatch — ship the compiled program in a
+        #     single run_plan dispatch per executor (executors cache the
+        #     program by fingerprint, so warm dispatches carry only the
+        #     binding) instead of per-stage spec shipping
+        #   planner.head_bypass — push lease-stamped block locations with
+        #     the dispatch so executors resolve sibling blocks peer-to-peer
+        #     (store.lookup_many head RPCs become the miss path)
+        self.plan_cache = True
+        self.compiled_dispatch = True
+        self.head_bypass = True
+        import collections
+
+        from raydp_tpu.sanitize import named_lock as _named_lock
+
+        self._plan_cache: "collections.OrderedDict" = collections.OrderedDict()  # guarded-by: self._plan_cache_lock
+        self._plan_cache_lock = _named_lock("planner.plan_cache")
+        self._plans_shipped: set = set()  # (actor_id, program_id) delivered
         # observability: rolling stats of the most recent query (SURVEY §5:
         # first-class step timing; the reference defers everything to the
         # Ray/Spark dashboards). Stage logs are thread-local so concurrent
@@ -92,6 +115,11 @@ class Planner:
         state.pop("scale_hook", None)
         state.pop("_inflight_lock", None)
         state["_inflight"] = 0
+        # the compiled-plan cache and its delivery bookkeeping are process-
+        # private (programs pin wire blobs; shipped-state is per connection)
+        state.pop("_plan_cache", None)
+        state.pop("_plan_cache_lock", None)
+        state.pop("_plans_shipped", None)
         return state
 
     def __setstate__(self, state):
@@ -106,6 +134,14 @@ class Planner:
         self.__dict__.setdefault("fuse_projects", True)
         self.__dict__.setdefault("executor_slots", 1)
         self.__dict__.setdefault("shuffle_indexed_blocks", True)
+        self.__dict__.setdefault("plan_cache", True)
+        self.__dict__.setdefault("compiled_dispatch", True)
+        self.__dict__.setdefault("head_bypass", True)
+        import collections
+
+        self._plan_cache = collections.OrderedDict()  # raydp-lint: disable=guarded-by (unpickle re-init: the object is not yet shared with any thread)
+        self._plan_cache_lock = named_lock("planner.plan_cache")
+        self._plans_shipped = set()
 
     # ------------------------------------------------------------------
     # task submission
@@ -132,9 +168,14 @@ class Planner:
             order.insert(0, first)
         for idx in order:
             try:
-                return self.executors[idx].run_task.remote(spec)
+                future = self.executors[idx].run_task.remote(spec)
             except _ActorDied as exc:
                 last_exc = exc
+                continue
+            from raydp_tpu import obs
+
+            obs.metrics.counter("etl.actor_dispatches").inc()
+            return future
         raise last_exc  # every executor is dead
 
     def _executor_nodes(self) -> List[Optional[str]]:
@@ -324,6 +365,9 @@ class Planner:
                         group,
                     )
                 )
+                from raydp_tpu import obs
+
+                obs.metrics.counter("etl.actor_dispatches").inc()
             except _ActorDied:
                 fallback.extend(group)
         results: List[Optional[T.TaskResult]] = [None] * len(specs)
@@ -617,18 +661,29 @@ class Planner:
             fused.append(node)
         return fused
 
+    def _prepare_chain_quiet(
+        self, chain: List[lp.PlanNode]
+    ) -> Tuple[List[lp.PlanNode], Optional[dict]]:
+        """Strip + fuse without emitting: returns (fused chain, fusion info
+        or None). The compiled-plan path records the info ON the program and
+        re-emits it per execution, so cache hits report the same fusion
+        decisions a fresh compile does."""
+        shipped = self._strip_children(chain)
+        fused = self._fuse_chain(shipped)
+        info = None
+        if len(fused) != len(shipped):
+            info = {"narrow_ops": len(shipped), "fused_ops": len(fused)}
+        return fused, info
+
     def _prepare_chain(self, chain: List[lp.PlanNode]) -> List[lp.PlanNode]:
         """Strip + fuse the narrow chain for shipping; each fusion decision
         becomes an ``etl.fusion`` instant — visible on the trace timeline
         AND collected into last_query_stats by ``_instrumented``."""
         from raydp_tpu import obs
 
-        shipped = self._strip_children(chain)
-        fused = self._fuse_chain(shipped)
-        if len(fused) != len(shipped):
-            obs.instant(
-                "etl.fusion", narrow_ops=len(shipped), fused_ops=len(fused)
-            )
+        fused, info = self._prepare_chain_quiet(chain)
+        if info is not None:
+            obs.instant("etl.fusion", **info)
         return fused
 
     # ------------------------------------------------------------------
@@ -703,7 +758,7 @@ class Planner:
         selects the block tier ("disk" = persist to each executor node's
         spill dir — DISK_ONLY storage-level semantics, no driver round-trip)."""
         results = self._instrumented(
-            lambda: self._execute(
+            lambda: self._execute_top(
                 node, T.OutputSpec("block", owner=self.owner, storage=storage)
             )
         )
@@ -714,7 +769,18 @@ class Planner:
 
     def execute_action(self, node: lp.PlanNode, output: T.OutputSpec) -> List[T.TaskResult]:
         """Run the plan with a custom terminal output (count/inline/parquet)."""
-        return self._instrumented(lambda: self._execute(node, output))
+        return self._instrumented(lambda: self._execute_top(node, output))
+
+    def _execute_top(
+        self, node: lp.PlanNode, output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        """Top-of-query entry: try the compiled-plan path (plan cache +
+        whole-plan dispatch) first; anything it cannot express falls back to
+        the recursive stage driver unchanged."""
+        results = self._try_compiled(node, output)
+        if results is not None:
+            return results
+        return self._execute(node, output)
 
     # span attrs copied into each last_query_stats stage entry, in schema
     # order (the schema test pins these keys)
@@ -734,11 +800,45 @@ class Planner:
             return run()  # nested (e.g. sort materializing its child):
             # stages contribute to the enclosing query's stats
         self._tls.query_active = True
+        # per-query control-plane accounting: process-wide counter deltas
+        # around the query (concurrent queries on one process interleave
+        # their deltas — documented; the counters themselves stay exact)
+        _PC = ("hits", "misses", "unsupported")
+        before = {
+            "head_rpcs": obs.metrics.counter("rpc.client.calls").value,
+            "dispatches": obs.metrics.counter("etl.actor_dispatches").value,
+            "bypass": obs.metrics.counter("rpc.head_bypass_hits").value,
+            **{k: obs.metrics.counter(f"plan_cache.{k}").value for k in _PC},
+        }
         try:
             with obs.collect() as records, obs.span("etl.query") as query_span:
                 results = run()
         finally:
             self._tls.query_active = False
+        plan_cache = {
+            k: int(obs.metrics.counter(f"plan_cache.{k}").value - before[k])
+            for k in _PC
+        }
+        plan_cache["hit"] = (
+            plan_cache["hits"] > 0 and plan_cache["misses"] == 0
+        )
+        rpc_stats = {
+            # control-plane round trips this query cost: head/agent RPCs
+            # (rpc.client.calls delta) and executor dispatches — the two
+            # numbers the millisecond control plane exists to drive to ~0/~1
+            "head_rpcs": int(
+                obs.metrics.counter("rpc.client.calls").value
+                - before["head_rpcs"]
+            ),
+            "actor_dispatches": int(
+                obs.metrics.counter("etl.actor_dispatches").value
+                - before["dispatches"]
+            ),
+            "head_bypass_hits": int(
+                obs.metrics.counter("rpc.head_bypass_hits").value
+                - before["bypass"]
+            ),
+        }
         stages = []
         fusion = []
         shuffle = []
@@ -766,6 +866,8 @@ class Planner:
             "stages": stages,
             "fusion": fusion,
             "shuffle": shuffle,
+            "plan_cache": plan_cache,
+            "rpc": rpc_stats,
         }
         return results
 
@@ -844,13 +946,35 @@ class Planner:
         clone.child = child  # type: ignore[attr-defined]
         return clone
 
+    def _block_reads(
+        self, blocks: List[Optional[store.ObjectRef]], schema_ipc: bytes
+    ) -> List[T.ReadSpec]:
+        """One ReadSpec per block, each carrying any lease-stamped location
+        THIS process already knows (head-bypass push: blocks the driver
+        wrote — from_arrow/from_pandas sources — resolve executor-side with
+        zero head RPCs)."""
+        reads = []
+        for b in blocks:
+            metas = {}
+            if b is not None and self.head_bypass:
+                entry = store.local_meta(b.object_id)
+                if entry is not None:
+                    metas[b.object_id] = entry
+            reads.append(
+                T.ReadSpec(
+                    "block",
+                    blocks=[b] if b is not None else [],
+                    schema_ipc=schema_ipc,
+                    metas=metas,
+                )
+            )
+        return reads
+
     def _source_reads(self, base: lp.PlanNode) -> List[T.ReadSpec]:
         if isinstance(base, lp.ArrowSource):
-            schema_ipc = T.schema_ipc_bytes(base.schema)
-            return [
-                T.ReadSpec("block", blocks=[b], schema_ipc=schema_ipc)
-                for b in base.blocks
-            ]
+            return self._block_reads(
+                list(base.blocks), T.schema_ipc_bytes(base.schema)
+            )
         if isinstance(base, lp.RangeSource):
             total = max(0, math.ceil((base.end - base.start) / base.step))
             per = math.ceil(total / base.num_partitions) if base.num_partitions else total
@@ -1053,6 +1177,7 @@ class Planner:
                 "etl.stage", tasks=len(map_specs) + num_reducers
             ) as stage_span:
                 try:
+                    obs.metrics.counter("etl.actor_dispatches").inc()
                     map_results, out = (
                         self.executors[0]
                         .run_shuffle.options(timeout=300.0 * waves)
@@ -1508,6 +1633,592 @@ class Planner:
             ), False
         return self.materialize(node), True
 
+    # ------------------------------------------------------------------
+    # compiled plans: plan cache + whole-plan dispatch (the millisecond
+    # control plane — repeated query shapes skip planning/lowering and ship
+    # as ONE run_plan per executor; see docs/etl.md "Interactive query
+    # latency")
+    # ------------------------------------------------------------------
+
+    PLAN_CACHE_CAP = 64
+    _UNSUPPORTED = object()  # negative-cache marker for uncompilable shapes
+
+    def plan_cache_stats(self) -> dict:
+        """Process-lifetime compiled-plan cache counters + current size."""
+        from raydp_tpu import obs
+
+        with self._plan_cache_lock:
+            size = sum(
+                1 for v in self._plan_cache.values() if v is not self._UNSUPPORTED
+            )
+        return {
+            "size": size,
+            "hits": int(obs.metrics.counter("plan_cache.hits").value),
+            "misses": int(obs.metrics.counter("plan_cache.misses").value),
+            "unsupported": int(
+                obs.metrics.counter("plan_cache.unsupported").value
+            ),
+        }
+
+    def plan_cache_clear(self) -> None:
+        """Drop every compiled program (sessions call this when a conf that
+        affects lowering changes mid-session; ordinary invalidation — conf or
+        schema change — happens naturally through the fingerprint)."""
+        with self._plan_cache_lock:
+            self._plan_cache.clear()
+        self._plans_shipped.clear()
+
+    def _try_compiled(
+        self, node: lp.PlanNode, output: T.OutputSpec
+    ) -> Optional[List[T.TaskResult]]:
+        """Fingerprint → cache probe → (compile on miss) → run. Returns None
+        for shapes the compiler doesn't express (join/sort/limit/union —
+        the recursive driver handles them exactly as before)."""
+        from raydp_tpu import obs
+        from raydp_tpu.etl import program as P
+
+        if not self.plan_cache and not self.compiled_dispatch:
+            return None
+        key = P.fingerprint_plan(
+            node,
+            (
+                output.kind, output.storage, output.path, tuple(output.keys),
+                output.seed, output.sample_limit, output.max_records,
+            ),
+            (
+                self.fuse_projects, self.shuffle_indexed_blocks,
+                self.default_parallelism,
+            ),
+        )
+        if key is None:
+            obs.metrics.counter("plan_cache.unsupported").inc()
+            return None
+        program = None
+        if self.plan_cache:
+            with self._plan_cache_lock:
+                entry = self._plan_cache.get(key.fingerprint)
+                if entry is not None:
+                    self._plan_cache.move_to_end(key.fingerprint)
+        else:
+            entry = None
+        if entry is self._UNSUPPORTED:
+            obs.metrics.counter("plan_cache.unsupported").inc()
+            return None
+        if entry is not None:
+            if entry.template_literals is not None and [
+                lit.value for lit in key.literals
+            ] != entry.template_literals:
+                entry = None  # unmappable literal changed: recompile
+            else:
+                obs.metrics.counter("plan_cache.hits").inc()
+                program = entry
+        if program is None:
+            program = self._compile_plan(node, output, key)
+            if self.plan_cache:
+                with self._plan_cache_lock:
+                    self._plan_cache[key.fingerprint] = (
+                        program if program is not None else self._UNSUPPORTED
+                    )
+                    self._plan_cache.move_to_end(key.fingerprint)
+                    while len(self._plan_cache) > self.PLAN_CACHE_CAP:
+                        self._plan_cache.popitem(last=False)
+            if program is None:
+                obs.metrics.counter("plan_cache.unsupported").inc()
+                return None
+            obs.metrics.counter("plan_cache.misses").inc()
+        return self._run_program(program, key, output)
+
+    def _compile_plan(self, node: lp.PlanNode, output: T.OutputSpec, key):
+        """Lower a plan into a CompiledProgram, or None when the shape is
+        out of the compiler's dialect (handled by the staged driver)."""
+        import dataclasses
+
+        from raydp_tpu.etl import program as P
+
+        base, chain = self._split_narrow(node)
+        out_template = dataclasses.replace(output, owner=None)
+        if isinstance(
+            base,
+            (lp.ArrowSource, lp.RangeSource, lp.ParquetSource, lp.CsvSource),
+        ):
+            is_arrow = isinstance(base, lp.ArrowSource)
+            if len(key.block_slots) != (1 if is_arrow else 0):
+                return None  # fingerprint/plan shape disagreement: bail
+            shipped, fusion = self._prepare_chain_quiet(chain)
+            maps = P.slot_map_for([shipped], key)
+            return P.SimpleProgram(
+                program_id=key.fingerprint,
+                chain=shipped,
+                slot_map=maps[0] if maps is not None else [],
+                template_literals=(
+                    None if maps is not None
+                    else [lit.value for lit in key.literals]
+                ),
+                source_reads=None if is_arrow else self._source_reads(base),
+                schema_ipc=(
+                    T.schema_ipc_bytes(base.schema) if is_arrow else None
+                ),
+                output=out_template,
+                fusion=[fusion] if fusion else [],
+            )
+        if isinstance(
+            base, (lp.Repartition, lp.GroupByAgg, lp.Distinct, lp.Window)
+        ):
+            return self._compile_exchange(base, chain, out_template, key)
+        return None
+
+    def _compile_exchange(self, base, chain, out_template, key):
+        """Lower a single-exchange plan (simple map side) to an
+        ExchangeProgram mirroring exactly what the corresponding
+        ``_execute_*`` method builds — the A/B parity tests hold the two
+        paths byte-identical."""
+        from raydp_tpu.etl import program as P
+
+        reduce_chain, fusion_r = self._prepare_chain_quiet(chain)
+        if isinstance(base, lp.Repartition):
+            n = self._num_partitions(base.num_partitions)
+            map_child = base.child
+            child_schema = self.infer_schema(base.child)
+            if base.by:
+                map_out = self._split_output(
+                    "hash_split", num_splits=n, keys=list(base.by)
+                )
+            elif base.shuffle_seed is not None:
+                map_out = self._split_output(
+                    "random_split", num_splits=n, seed=base.shuffle_seed
+                )
+            else:
+                map_out = self._split_output("round_robin_split", num_splits=n)
+            if base.shuffle_seed is not None:
+                reduce_chain = [
+                    lp.MapBatches(None, _IntraShuffle(base.shuffle_seed))  # type: ignore[arg-type]
+                ] + reduce_chain
+            merge = T.MergeSpec("none")
+        elif isinstance(base, lp.GroupByAgg):
+            n = 1 if not base.keys else self._num_partitions(base.num_partitions)
+            map_child = lp.MapBatches(
+                base.child, _PartialAgg(base.keys, base.aggs)
+            )
+            child_schema = T.partial_agg(
+                self._empty_result(base.child), base.keys, base.aggs
+            ).schema
+            if base.keys:
+                map_out = self._split_output(
+                    "hash_split", num_splits=n, keys=list(base.keys)
+                )
+            else:
+                map_out = T.OutputSpec("block")
+            merge = T.MergeSpec(
+                "final_agg", keys=list(base.keys), aggs=list(base.aggs)
+            )
+        elif isinstance(base, lp.Distinct):
+            n = self._num_partitions(base.num_partitions)
+            child_schema = self.infer_schema(base.child)
+            map_child = lp.MapBatches(base.child, _LocalDistinct())
+            map_out = self._split_output(
+                "hash_split", num_splits=n, keys=list(child_schema.names)
+            )
+            merge = T.MergeSpec("distinct")
+        else:  # Window
+            child_schema = self.infer_schema(base.child)
+            apply_node = lp.MapBatches(
+                None,  # type: ignore[arg-type]
+                T.WindowApply(
+                    base.partition_by, base.order_by, base.ascending,
+                    base.exprs,
+                ),
+            )
+            if base.partition_by:
+                n = self._num_partitions(base.num_partitions)
+                map_out = self._split_output(
+                    "hash_split", num_splits=n, keys=list(base.partition_by)
+                )
+            else:
+                n = 1
+                map_out = T.OutputSpec("block")
+            map_child = base.child
+            reduce_chain = [apply_node] + reduce_chain
+            merge = T.MergeSpec("none")
+        m_base, m_chain = self._split_narrow(map_child)
+        if not isinstance(
+            m_base,
+            (lp.ArrowSource, lp.RangeSource, lp.ParquetSource, lp.CsvSource),
+        ):
+            return None  # composite map side: staged legacy path
+        is_arrow = isinstance(m_base, lp.ArrowSource)
+        if len(key.block_slots) != (1 if is_arrow else 0):
+            return None
+        map_shipped, fusion_m = self._prepare_chain_quiet(m_chain)
+        maps = P.slot_map_for([map_shipped, reduce_chain], key)
+        return P.ExchangeProgram(
+            program_id=key.fingerprint,
+            map_chain=map_shipped,
+            map_slot_map=maps[0] if maps is not None else [],
+            reduce_chain=reduce_chain,
+            reduce_slot_map=maps[1] if maps is not None else [],
+            template_literals=(
+                None if maps is not None
+                else [lit.value for lit in key.literals]
+            ),
+            source_reads=None if is_arrow else self._source_reads(m_base),
+            schema_ipc=(
+                T.schema_ipc_bytes(m_base.schema) if is_arrow else None
+            ),
+            map_out=map_out,
+            merge=merge,
+            child_schema_ipc=T.schema_ipc_bytes(child_schema),
+            num_reducers=n,
+            output=out_template,
+            fusion=[f for f in (fusion_m, fusion_r) if f],
+        )
+
+    def _run_program(
+        self, program, key, output: T.OutputSpec
+    ) -> List[T.TaskResult]:
+        from raydp_tpu import obs
+
+        for info in program.fusion:
+            obs.instant("etl.fusion", **info)
+        binding = {
+            "literals": [lit.value for lit in key.literals],
+            "owner": output.owner,
+            "storage": output.storage,
+            "indexed": self.shuffle_indexed_blocks,
+        }
+        if program.source_reads is not None:
+            reads = program.source_reads
+        else:
+            blocks = key.block_slots[0] if key.block_slots else []
+            reads = self._block_reads(list(blocks), program.schema_ipc)
+        if program.kind == "simple":
+            return self._run_simple_program(program, reads, binding)
+        return self._run_exchange_program(program, reads, binding)
+
+    def _send_plan(self, idx: int, program, binding, with_blob: bool = False):
+        """One run_plan dispatch. The program body ships only on the FIRST
+        delivery to an actor (or on a ProgramCacheMiss retry after an
+        executor restart/eviction): warm dispatches carry just the
+        fingerprint + binding."""
+        from raydp_tpu import obs
+        from raydp_tpu.etl import program as P
+
+        handle = self.executors[idx]
+        shipped_key = (handle._actor_id, program.program_id)
+        blob = None
+        if with_blob or shipped_key not in self._plans_shipped:
+            blob = P.wire_blob(program)
+        tasks = len(binding["indices"]) + (
+            program.num_reducers if program.kind == "exchange" else 0
+        )
+        waves = -(-tasks // max(1, self.executor_slots))
+        future = handle.run_plan.options(
+            timeout=300.0 * max(1, waves)
+        ).remote(program.program_id, binding, blob)
+        self._plans_shipped.add(shipped_key)
+        obs.metrics.counter("etl.actor_dispatches").inc()
+        return future
+
+    def _await_plan(self, future, idx: int, program, binding):
+        """Gather one run_plan reply: a ProgramCacheMiss re-dispatches once
+        WITH the program body; delivery failure returns None (the caller
+        falls back to the staged retry ladder — the same surface a batched
+        stage has). Application errors propagate."""
+        from raydp_tpu.etl import program as P
+
+        try:
+            try:
+                return future.result()
+            except P.ProgramCacheMiss:
+                return self._send_plan(
+                    idx, program, binding, with_blob=True
+                ).result()
+        except (ConnectionError, EOFError, _ActorDied):
+            return None
+        except AttributeError as exc:
+            # only the missing-method signature of an older executor falls
+            # back; a genuine AttributeError in a task body must propagate
+            if "run_plan" not in str(exc):
+                raise
+            return None
+
+    def _plan_groups(self, reads: List[T.ReadSpec]) -> Tuple[List[List[int]], int]:
+        """Partition→executor grouping for whole-plan dispatch. Locality
+        comes from the pushed/cached location records first (zero RPCs for
+        driver-written sources — the warm interactive path); blocks the
+        driver has never seen (executor/agent-written) fall back to ONE
+        batched head ``object_locations`` lookup, exactly like the staged
+        path."""
+        n = len(self.executors)
+        nodes = self._executor_nodes()
+        groups: List[List[int]] = [[] for _ in range(n)]
+        npref = 0
+        unplaced: List[int] = []
+
+        def _known_node(read: T.ReadSpec, b) -> Optional[str]:
+            entry = read.metas.get(b.object_id)
+            meta = entry[0] if entry else store.cached_location(b.object_id)
+            return meta.get("node_id") if meta else None
+
+        locations: dict = {}
+        if n >= 2:
+            unknown = list(
+                {
+                    b.object_id
+                    for read in reads
+                    for b in read.blocks
+                    if b is not None and _known_node(read, b) is None
+                }
+            )
+            if unknown:
+                from raydp_tpu.cluster import api as cluster_api
+
+                try:
+                    locations = cluster_api.head_rpc(
+                        "object_locations", object_ids=unknown
+                    )
+                except Exception:  # raydp-lint: disable=swallowed-exceptions (locality is advisory; placement degrades to round-robin)
+                    locations = {}
+        for i, read in enumerate(reads):
+            weight: dict = {}
+            for b in read.blocks:
+                if b is None:
+                    continue
+                node = _known_node(read, b) or locations.get(b.object_id)
+                if node is not None:
+                    weight[node] = weight.get(node, 0) + max(1, b.size)
+            best = max(weight, key=weight.get) if weight else None
+            candidates = (
+                [j for j, nd in enumerate(nodes) if nd == best] if best else []
+            )
+            if candidates:
+                groups[candidates[i % len(candidates)]].append(i)
+                npref += 1
+            else:
+                unplaced.append(i)
+        for i in unplaced:
+            groups[min(range(n), key=lambda g: len(groups[g]))].append(i)
+        return groups, npref
+
+    def _run_simple_program(
+        self, program, reads: List[T.ReadSpec], binding
+    ) -> List[T.TaskResult]:
+        """A simple program over the pool: ONE run_plan dispatch per
+        executor (its whole partition group), with submit()'s side-effect
+        surface — scale hook, inflight guard, stage span, metrics — and a
+        per-task retry-ladder fallback for failed deliveries."""
+        from raydp_tpu import obs
+        from raydp_tpu.etl import program as P
+
+        indices = list(range(len(reads)))
+        if not self.executors or not self.compiled_dispatch:
+            specs = P.build_simple_specs(
+                program, {**binding, "reads": reads, "indices": indices}
+            )
+            return self.submit(specs)
+        hook = self.scale_hook
+        if hook is not None:
+            with self._inflight_lock:
+                self._inflight += 1
+            try:
+                hook(len(reads))
+            except Exception:
+                obs.metrics.counter("etl.scale_hook_failures").inc()
+        try:
+            with obs.span("etl.stage", tasks=len(reads)) as stage_span:
+                groups, npref = self._plan_groups(reads)
+                futures = []
+                for idx, group in enumerate(groups):
+                    if not group:
+                        continue
+                    b = {
+                        **binding,
+                        "reads": [reads[i] for i in group],
+                        "indices": group,
+                    }
+                    try:
+                        futures.append(
+                            (self._send_plan(idx, program, b), idx, group, b)
+                        )
+                    except _ActorDied:
+                        futures.append((None, idx, group, b))
+                results: List[Optional[T.TaskResult]] = [None] * len(reads)
+                fallback: List[int] = []
+                for future, idx, group, b in futures:
+                    batch = (
+                        self._await_plan(future, idx, program, b)
+                        if future is not None
+                        else None
+                    )
+                    if batch is None:
+                        fallback.extend(group)
+                        continue
+                    for i, r in zip(group, batch):
+                        results[i] = r
+                if fallback:
+                    fallback.sort()
+                    obs.instant(
+                        "etl.batch_retry", tasks=len(fallback), attempt=1
+                    )
+                    obs.metrics.counter("etl.task_retries").inc(len(fallback))
+                    dense = P.build_simple_specs(
+                        program,
+                        {
+                            **binding,
+                            "reads": [reads[i] for i in fallback],
+                            "indices": fallback,
+                        },
+                    )
+                    retry = [
+                        (self._dispatch(dense[j], fallback[j], 1), dense[j], j)
+                        for j in range(len(dense))
+                    ]
+                    for j, r in enumerate(self._gather(retry, dense)):
+                        results[fallback[j]] = r
+                stage_span.set(
+                    dispatch="compiled",
+                    locality_preferred=npref,
+                    server_seconds=round(
+                        sum(r.server_seconds for r in results), 6
+                    ),
+                    read_s=round(sum(r.read_seconds for r in results), 6),
+                    compute_s=round(
+                        sum(r.compute_seconds for r in results), 6
+                    ),
+                    emit_s=round(sum(r.emit_seconds for r in results), 6),
+                )
+            obs.metrics.counter("etl.stages").inc()
+            obs.metrics.counter("etl.tasks_dispatched").inc(len(reads))
+            obs.metrics.counter("etl.compiled_dispatches").inc()
+            return results  # type: ignore[return-value]
+        finally:
+            if hook is not None:
+                with self._inflight_lock:
+                    self._inflight -= 1
+
+    def _run_exchange_program(
+        self, program, reads: List[T.ReadSpec], binding
+    ) -> List[T.TaskResult]:
+        if len(self.executors) == 1 and self.compiled_dispatch:
+            out = self._dispatch_plan_exchange(program, reads, binding)
+            if out is not None:
+                return out
+        return self._run_exchange_staged(program, reads, binding)
+
+    def _dispatch_plan_exchange(
+        self, program, reads: List[T.ReadSpec], binding
+    ) -> Optional[List[T.TaskResult]]:
+        """Single-executor pools run the whole map→reduce graph from ONE
+        run_plan dispatch (the generalization of PR 3's run_shuffle to
+        compiled programs). Falls back to the staged path on any delivery
+        failure. Side-effect parity with submit(): scale hook consulted
+        pre-dispatch, inflight guard held across the dispatch."""
+        from raydp_tpu import obs
+        from raydp_tpu.etl import program as P  # noqa: F401 - via _await_plan
+
+        hook = self.scale_hook
+        if hook is not None:
+            try:
+                hook(len(reads))
+            except Exception:
+                from raydp_tpu.obs import metrics
+
+                metrics.counter("etl.scale_hook_failures").inc()
+            if len(self.executors) != 1:
+                return None  # pool grew: fused single-dispatch no longer applies
+        b = {**binding, "reads": reads, "indices": list(range(len(reads)))}
+        if hook is not None:
+            with self._inflight_lock:
+                self._inflight += 1
+        batch = None
+        try:
+            with obs.span(
+                "etl.stage", tasks=len(reads) + program.num_reducers
+            ) as stage_span:
+                try:
+                    batch = self._await_plan(
+                        self._send_plan(0, program, b), 0, program, b
+                    )
+                except _ActorDied:
+                    batch = None
+                if batch is None:
+                    stage_span.set(
+                        dispatch="compiled_failed", server_seconds=0.0,
+                        read_s=0.0, compute_s=0.0, emit_s=0.0,
+                    )
+                else:
+                    map_results, out = batch
+                    stage_span.set(
+                        dispatch="compiled_fused",
+                        server_seconds=round(
+                            sum(r.server_seconds for r in map_results + out), 6
+                        ),
+                        read_s=round(
+                            sum(r.read_seconds for r in map_results + out), 6
+                        ),
+                        compute_s=round(
+                            sum(r.compute_seconds for r in map_results + out),
+                            6,
+                        ),
+                        emit_s=round(
+                            sum(r.emit_seconds for r in map_results + out), 6
+                        ),
+                    )
+        finally:
+            if hook is not None:
+                with self._inflight_lock:
+                    self._inflight -= 1
+        if batch is None:
+            return None
+        map_results, out = batch
+        obs.metrics.counter("etl.stages").inc()
+        obs.metrics.counter("etl.tasks_dispatched").inc(
+            len(reads) + program.num_reducers
+        )
+        obs.metrics.counter("etl.fused_exchanges").inc()
+        obs.metrics.counter("etl.compiled_dispatches").inc()
+        blocks = [
+            blk for res in map_results for blk in res.blocks if blk is not None
+        ]
+        obs.instant(
+            "etl.shuffle",
+            map_tasks=len(reads),
+            reducers=program.num_reducers,
+            blocks=len(blocks),
+            bytes=sum(blk.size for blk in blocks),
+            indexed=bool(
+                program.map_out.kind.endswith("_split")
+                and binding.get("indexed", True)
+            ),
+            dispatch="compiled",
+            reduce_start_lag_s=0.0,
+        )
+        self._delete_blocks(blocks)
+        return out
+
+    def _run_exchange_staged(
+        self, program, reads: List[T.ReadSpec], binding
+    ) -> List[T.TaskResult]:
+        """Multi-executor (or fallback) execution of a compiled exchange:
+        the PR 3 barrier-free launcher, with every piece — map specs, reduce
+        prototypes, schemas — prebuilt by the compiler instead of re-lowered
+        per query."""
+        from raydp_tpu.etl import program as P
+
+        b = {**binding, "reads": reads, "indices": list(range(len(reads)))}
+        map_specs, reduce_spec = P.build_exchange_stages(program, b)
+        launcher = _ReduceLauncher(
+            self,
+            program.num_reducers,
+            lambda r, side_reads: reduce_spec(r, side_reads[0]),
+        )
+        side = launcher.add_side_ipc(program.child_schema_ipc)
+        launcher.begin_side(side, len(map_specs))
+        map_results = self.submit(map_specs, on_result=launcher.observer(side))
+        out = launcher.gather()
+        launcher.emit_stats(indexed=bool(map_specs[0].output.indexed_splits))
+        self._cleanup_intermediate(map_results)
+        return out
+
 
 class _ReduceLauncher:
     """Barrier-free reduce start: per-reducer readiness tracked from
@@ -1537,9 +2248,14 @@ class _ReduceLauncher:
         self.dispatch_t: Optional[float] = None
 
     def add_side(self, schema: pa.Schema) -> int:
+        return self.add_side_ipc(T.schema_ipc_bytes(schema))
+
+    def add_side_ipc(self, schema_ipc: bytes) -> int:
+        """Register a side by its already-serialized schema (compiled
+        programs carry schema IPC bytes; no re-serialization per query)."""
         self._sides.append(
             {
-                "schema_ipc": T.schema_ipc_bytes(schema),
+                "schema_ipc": schema_ipc,
                 "results": None,  # per-map slot list, filled in map order
                 "seen": 0,
             }

@@ -210,6 +210,32 @@ class EtlSession:
         self._planner.shuffle_indexed_blocks = str(
             self.configs.get("planner.shuffle_indexed_blocks", "true")
         ).lower() in ("1", "true", "yes")
+
+        # millisecond control plane knobs (all default ON; parity tests flip
+        # them for A/B byte-identical comparisons — see docs/etl.md
+        # "Interactive query latency"):
+        #   planner.plan_cache        — compiled-plan cache (fingerprint →
+        #                               lowered program; literals/blocks
+        #                               rebind without recompilation)
+        #   planner.compiled_dispatch — whole-plan run_plan dispatch (one
+        #                               RPC per executor per query)
+        #   planner.head_bypass       — lease-stamped location pushing +
+        #                               executor-side location cache (head
+        #                               lookups become the miss path)
+        #   cluster.doorbell          — persistent actor dispatch sockets
+        #                               (skip per-call connect/handshake)
+        def _flag(name: str, default: str = "true") -> bool:
+            return str(self.configs.get(name, default)).lower() in (
+                "1", "true", "yes",
+            )
+
+        self._planner.plan_cache = _flag("planner.plan_cache")
+        self._planner.compiled_dispatch = _flag("planner.compiled_dispatch")
+        self._planner.head_bypass = _flag("planner.head_bypass")
+        from raydp_tpu.store import object_store as _store
+
+        _store.set_location_cache(self._planner.head_bypass)
+        cluster.set_doorbell(_flag("cluster.doorbell"))
         from raydp_tpu.etl import tasks as _tasks
 
         _tasks.set_arrow_threads(

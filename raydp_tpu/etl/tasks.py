@@ -238,6 +238,12 @@ class ReadSpec:
     # of map tasks' single-block outputs (write_indexed_splits); readable
     # alongside ``blocks`` (legacy whole-block inputs)
     slices: List[Tuple[store.ObjectRef, int, int]] = field(default_factory=list)
+    # head-bypass: lease-stamped location records for this read's blocks,
+    # pushed by the dispatching driver ({object_id: (meta, age_s)}) — the
+    # executor seeds its location cache from these, so resolving sibling
+    # map outputs costs ZERO head RPCs on the warm path (store.lookup_many
+    # falls back to the head only for entries absent or past their lease)
+    metas: Dict[str, Any] = field(default_factory=dict)
 
 
 @dataclass
@@ -292,6 +298,10 @@ class TaskResult:
     blocks: List[Optional[store.ObjectRef]] = field(default_factory=list)
     num_rows: List[int] = field(default_factory=list)
     split_slices: Optional[List[Optional[Tuple[int, int]]]] = None
+    # location records for the produced blocks, parallel to ``blocks`` —
+    # the WRITER knows where its output lives, so downstream reads (reduce
+    # tasks, driver-side slicing) can resolve them head-bypass
+    block_metas: Optional[List[Optional[Any]]] = None
     inline_ipc: Optional[bytes] = None
     count: int = 0
     # server-side wall time of the task body (read→compute→emit), for query
@@ -312,6 +322,10 @@ class TaskResult:
 
 def _read_one(read: ReadSpec) -> pa.Table:
     if read.kind == "block":
+        if read.metas:
+            # adopt the dispatcher's lease-stamped locations BEFORE any
+            # lookup: warm reads resolve peer blocks without the head
+            store.seed_locations(read.metas)
         tables = [read_table_block(r) for r in read.blocks if r is not None]
         if read.slices:
             # one vectorized metadata lookup for every input slice's block,
@@ -385,9 +399,16 @@ def build_shuffle_reads(
     concatenation order is part of the engine's determinism contract —
     first/last aggregates depend on it)."""
     reads: List[ReadSpec] = []
+
+    def _meta_of(res: "TaskResult", idx: int):
+        if res.block_metas is not None and idx < len(res.block_metas):
+            return res.block_metas[idx]
+        return None
+
     for r in range(num_reducers):
         blocks: List[store.ObjectRef] = []
         slices: List[Tuple[store.ObjectRef, int, int]] = []
+        metas: Dict[str, Any] = {}
         for res in map_results:
             if res is None:
                 continue
@@ -400,11 +421,18 @@ def build_shuffle_reads(
                 )
                 if ref is not None and s is not None:
                     slices.append((ref, s[0], s[1]))
+                    meta = _meta_of(res, 0)
+                    if meta is not None:
+                        metas[ref.object_id] = meta
             elif r < len(res.blocks) and res.blocks[r] is not None:
                 blocks.append(res.blocks[r])
+                meta = _meta_of(res, r)
+                if meta is not None:
+                    metas[res.blocks[r].object_id] = meta
         reads.append(
             ReadSpec(
-                "block", blocks=blocks, slices=slices, schema_ipc=schema_ipc
+                "block", blocks=blocks, slices=slices,
+                schema_ipc=schema_ipc, metas=metas,
             )
         )
     return reads
@@ -1041,7 +1069,10 @@ def _emit(table: pa.Table, spec: TaskSpec) -> TaskResult:
             table, owner=out.owner, max_records=out.max_records,
             storage=out.storage,
         )
-        return TaskResult(blocks=[ref], num_rows=[n])
+        return TaskResult(
+            blocks=[ref], num_rows=[n],
+            block_metas=[store.local_meta(ref.object_id)],
+        )
     if out.kind == "parquet":
         import pyarrow.parquet as pq
 
@@ -1096,6 +1127,9 @@ def _emit(table: pa.Table, spec: TaskSpec) -> TaskResult:
             blocks=[ref] if ref is not None else [],
             num_rows=counts,
             split_slices=slices,
+            block_metas=(
+                [store.local_meta(ref.object_id)] if ref is not None else []
+            ),
         )
     refs: List[Optional[store.ObjectRef]] = []
     counts: List[int] = []
@@ -1111,4 +1145,11 @@ def _emit(table: pa.Table, spec: TaskSpec) -> TaskResult:
                 )
                 refs.append(ref)
                 counts.append(n)
-    return TaskResult(blocks=refs, num_rows=counts)
+    return TaskResult(
+        blocks=refs,
+        num_rows=counts,
+        block_metas=[
+            store.local_meta(r.object_id) if r is not None else None
+            for r in refs
+        ],
+    )

@@ -38,6 +38,7 @@ from raydp_tpu.cluster import api as cluster_api
 from raydp_tpu.cluster.common import (
     DRIVER_OWNER,
     ClusterError,
+    OwnerDiedError,
     object_meta_entry,
     rpc,
     shm_namespace,
@@ -48,12 +49,111 @@ from raydp_tpu.cluster.common import (
 # pull path actually ran in multi-node scenarios)
 stats = {"remote_fetches": 0, "remote_bytes": 0}
 
+# ---------------------------------------------------------------------------
+# head-bypass location cache
+#
+# Every registration already knows the full location record it sends to the
+# head (object_meta_entry), so the writer caches it locally; readers resolve
+# through the cache first and only RPC the head for misses. Compiled-plan
+# dispatches additionally PUSH lease-stamped entries with the task specs
+# (ReadSpec.metas), so a reducer resolving sibling map outputs — blocks it
+# never wrote — still skips the head on the warm path. Entries are
+# lease-stamped: expired entries take the head miss path, and a read that
+# finds a cache-served segment gone retries once through the head (which is
+# authoritative for deletion and owner-death, so OwnerDiedError semantics
+# survive the bypass).
+# ---------------------------------------------------------------------------
+
+LOCATION_LEASE_ENV = "RAYDP_TPU_LOCATION_LEASE_S"
+_LOCATION_CACHE_CAP = 8192
+_location_enabled = True
+_location_cache: dict = {}  # object_id -> (meta, stamp, lease_s); guarded-by: _location_lock
+
+
+def default_location_lease_s() -> float:
+    import os as _os
+
+    try:
+        return float(_os.environ.get(LOCATION_LEASE_ENV, "") or 120.0)
+    except ValueError:
+        return 120.0
+
+
+def set_location_cache(enabled: bool) -> None:
+    """Session-conf toggle (``planner.head_bypass``): off = every lookup is
+    a head RPC, the pre-cache behavior (the A/B parity path)."""
+    global _location_enabled
+    _location_enabled = bool(enabled)
+    if not enabled:
+        with _location_lock:
+            _location_cache.clear()
+
+
+def cache_location(
+    object_id: str, meta: dict, stamp: Optional[float] = None,
+    lease_s: Optional[float] = None,
+) -> None:
+    import time as _time
+
+    if not _location_enabled:
+        return
+    with _location_lock:
+        if len(_location_cache) >= _LOCATION_CACHE_CAP:
+            # FIFO eviction: dict order is insertion order
+            for old in list(_location_cache)[: _LOCATION_CACHE_CAP // 8]:
+                del _location_cache[old]
+        _location_cache[object_id] = (
+            dict(meta),
+            _time.monotonic() if stamp is None else stamp,
+            default_location_lease_s() if lease_s is None else lease_s,
+        )
+
+
+def cached_location(object_id: str) -> Optional[dict]:
+    """A lease-fresh, locally-usable location record, or None (miss path).
+    The returned dict is marked ``cached`` so readers know a mapping failure
+    should retry through the head instead of raising."""
+    import time as _time
+
+    if not _location_enabled:
+        return None
+    with _location_lock:
+        entry = _location_cache.get(object_id)
+    if entry is None:
+        return None
+    meta, stamp, lease_s = entry
+    if _time.monotonic() - stamp > lease_s:
+        return None  # lease expired: authoritative path
+    if meta.get("shm_ns", "") != shm_namespace() and not meta.get("fetch_addr"):
+        return None  # foreign block with no pull address: must ask the head
+    out = dict(meta)
+    out["cached"] = True
+    return out
+
+
+def evict_location(object_id: str) -> None:
+    with _location_lock:
+        _location_cache.pop(object_id, None)
+
+
+def seed_locations(entries: dict) -> None:
+    """Adopt lease-stamped entries pushed with a task's ReadSpecs:
+    ``{object_id: (meta, age_s)}`` where ``age_s`` is how old the entry
+    already was when the DRIVER shipped it (monotonic clocks don't compare
+    across processes, so the wire format carries age, not a timestamp)."""
+    import time as _time
+
+    now = _time.monotonic()
+    for object_id, (meta, age_s) in entries.items():
+        cache_location(object_id, meta, stamp=now - max(0.0, float(age_s)))
+
 _NATIVE_DIR = os.path.join(os.path.dirname(__file__), "native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libraydp_store.so")
 from raydp_tpu import sanitize as _sanitize
 
 _lib_lock = _sanitize.named_lock("store._lib_lock", threading.Lock())
 _lib: Optional[ctypes.CDLL] = None  # guarded-by: _lib_lock
+_location_lock = _sanitize.named_lock("store.location_cache", threading.Lock())
 
 
 def _load_native() -> ctypes.CDLL:
@@ -263,6 +363,10 @@ def _register(ref: ObjectRef, owner: Optional[str], shm_name: Optional[str] = No
         node_id=ctx.node_id if ctx else "driver",
         shm_ns=shm_namespace(),
     )
+    # the writer knows the full location record: cache it so this process's
+    # own reads (and the compiled-plan dispatches that push it to peers)
+    # never ask the head where the block lives
+    cache_location(ref.object_id, entry)
     staged = getattr(_register_batch_tls, "stack", None)
     if staged:
         # a batched_registration() scope is active on this thread: stage the
@@ -318,6 +422,7 @@ def _discard_staged(entries: List[dict]) -> None:
 
         metrics.counter("store.delete_failures").inc(len(entries))
     for entry in entries:
+        evict_location(entry["object_id"])
         unlink_block(entry["shm_name"])
 
 
@@ -650,37 +755,92 @@ def _put_spill(object_id: str, buf, owner: Optional[str]) -> ObjectRef:
     return ref
 
 
-def _lookup(ref: ObjectRef) -> dict:
+def _lookup(ref: ObjectRef, fresh: bool = False) -> dict:
+    if not fresh:
+        meta = cached_location(ref.object_id)
+        if meta is not None:
+            from raydp_tpu.obs import metrics
+
+            metrics.counter("rpc.head_bypass_hits").inc()
+            return meta
     meta = cluster_api.head_rpc("object_lookup", object_id=ref.object_id)
     if meta is None:
         raise ClusterError(f"object {ref.object_id} not found (already deleted?)")
+    cache_location(ref.object_id, meta)
     return meta
 
 
-def lookup_many(refs: Sequence[ObjectRef]) -> dict:
-    """Resolve many refs' metadata in ONE RPC frame: {object_id: meta}.
-    The reduce side of a shuffle resolves every input slice's block through
-    this instead of one ``object_lookup`` round trip per block. Raises (like
-    ``_lookup``) if any object is missing or its owner died; falls back to
-    per-ref lookups against an older head."""
-    ids = list({r.object_id for r in refs})
-    if not ids:
-        return {}
+def _lookup_batch_rpc(ids: List[str]) -> dict:
+    """One head round trip for many ids — the lease-stamped op when the head
+    has it (entries enter the cache with the SERVER's lease), the PR 3 batch
+    lookup otherwise, per-ref lookups against the oldest heads."""
     try:
-        metas = cluster_api.head_rpc("object_lookup_batch", object_ids=ids)
+        metas = cluster_api.head_rpc("object_lookup_lease", object_ids=ids)
     except ClusterError as exc:
         if "unknown head method" not in str(exc):
             raise
-        metas = {
-            oid: cluster_api.head_rpc("object_lookup", object_id=oid)
-            for oid in ids
-        }
-    missing = [oid for oid in ids if metas.get(oid) is None]
+        try:
+            metas = cluster_api.head_rpc("object_lookup_batch", object_ids=ids)
+        except ClusterError as exc2:
+            if "unknown head method" not in str(exc2):
+                raise
+            metas = {
+                oid: cluster_api.head_rpc("object_lookup", object_id=oid)
+                for oid in ids
+            }
+    for oid, meta in metas.items():
+        if meta is not None:
+            cache_location(oid, meta, lease_s=meta.get("lease_s"))
+    return metas
+
+
+def lookup_many(refs: Sequence[ObjectRef]) -> dict:
+    """Resolve many refs' metadata: {object_id: meta}. The reduce side of a
+    shuffle resolves every input slice's block through this. Warm entries —
+    writer-cached, lease entries pushed with the task spec, or previously
+    fetched — are served from the local location cache (counted as
+    ``rpc.head_bypass_hits``); only misses cost a head round trip. Raises
+    (like ``_lookup``) if any object is missing or its owner died."""
+    ids = list({r.object_id for r in refs})
+    if not ids:
+        return {}
+    metas: dict = {}
+    missing: List[str] = []
+    for oid in ids:
+        meta = cached_location(oid)
+        if meta is not None:
+            metas[oid] = meta
+        else:
+            missing.append(oid)
+    if metas:
+        from raydp_tpu.obs import metrics
+
+        metrics.counter("rpc.head_bypass_hits").inc(len(metas))
     if missing:
+        metas.update(_lookup_batch_rpc(missing))
+    absent = [oid for oid in ids if metas.get(oid) is None]
+    if absent:
         raise ClusterError(
-            f"object(s) {missing[:3]} not found (already deleted?)"
+            f"object(s) {absent[:3]} not found (already deleted?)"
         )
     return metas
+
+
+def local_meta(object_id: str):
+    """The raw cache entry ``(meta, age_s)`` for a block THIS process knows
+    about, in the wire form ReadSpec.metas carries (age, not timestamp —
+    monotonic clocks don't compare across processes). None when unknown or
+    the cache is disabled."""
+    import time as _time
+
+    if not _location_enabled:
+        return None
+    with _location_lock:
+        entry = _location_cache.get(object_id)
+    if entry is None:
+        return None
+    meta, stamp, _lease = entry
+    return dict(meta), max(0.0, _time.monotonic() - stamp)
 
 
 class _FetchedBuffer:
@@ -764,6 +924,18 @@ def _remote_fetch(ref: ObjectRef, meta: dict, offset: int, length: int) -> bytes
     return data[:length]
 
 
+def _retry_uncached(ref: ObjectRef, meta: Optional[dict], exc: BaseException):
+    """A read through a CACHE-SERVED location that found the segment/file
+    gone re-resolves through the head once — the head is authoritative for
+    deletion and owner death, so the caller gets OwnerDiedError / a clean
+    not-found instead of a stale-bypass artifact. Returns the fresh meta, or
+    re-raises ``exc`` when the location didn't come from the cache."""
+    if meta is None or not meta.get("cached"):
+        raise exc
+    evict_location(ref.object_id)
+    return _lookup(ref, fresh=True)
+
+
 def get_buffer(ref: ObjectRef, meta: Optional[dict] = None):
     """View of the object's bytes: a zero-copy shm mapping when the object
     lives in THIS node's namespace, otherwise a network pull from the owning
@@ -773,7 +945,20 @@ def get_buffer(ref: ObjectRef, meta: Optional[dict] = None):
     via head if the owner died untransferred. The registered size is
     authoritative — the segment may be 1 byte for empty objects or
     capacity-sized if finalize was skipped. ``meta`` (from ``lookup_many``)
-    skips the per-object lookup RPC."""
+    skips the per-object lookup RPC; a cache-served meta whose segment turns
+    out gone retries once through the head."""
+    if meta is None:
+        meta = _lookup(ref)
+    try:
+        return _get_buffer_resolved(ref, meta)
+    except (ClusterError, ConnectionError, OSError) as exc:
+        if isinstance(exc, OwnerDiedError):
+            raise
+        fresh = _retry_uncached(ref, meta, exc)
+        return _get_buffer_resolved(ref, fresh)
+
+
+def _get_buffer_resolved(ref: ObjectRef, meta: Optional[dict] = None):
     if meta is None:
         meta = _lookup(ref)
     if meta["size"] == 0:
@@ -840,7 +1025,13 @@ def get_arrow_buffer(
         return pa.py_buffer(b"")
     if ranged and meta.get("shm_ns", "") != shm_namespace():
         # ranged network pull: only the slice crosses the wire
-        return pa.py_buffer(_remote_fetch(ref, meta, offset, length))
+        try:
+            return pa.py_buffer(_remote_fetch(ref, meta, offset, length))
+        except (ClusterError, ConnectionError, OSError) as exc:
+            if isinstance(exc, OwnerDiedError):
+                raise
+            fresh = _retry_uncached(ref, meta, exc)
+            return pa.py_buffer(_remote_fetch(ref, fresh, offset, length))
     buf = get_buffer(ref, meta=meta)
     if ranged:
         from raydp_tpu.obs import metrics
@@ -883,6 +1074,8 @@ def transfer(refs: Sequence[ObjectRef], new_owner: str) -> None:
 
 
 def delete(refs: Sequence[ObjectRef]) -> None:
+    for r in refs:
+        evict_location(r.object_id)
     cluster_api.head_rpc("object_delete", object_ids=[r.object_id for r in refs])
 
 

@@ -1,0 +1,19 @@
+"""Seeded violations on the actor-dispatch plane: typo'd method name and an
+arity-breaking call through ``.options(...).remote``."""
+
+
+class MiniExecutor:
+    def run_plan(self, program_id, binding, program_blob=None):
+        return binding
+
+    def ping(self):
+        return 0
+
+
+def client(handle):
+    handle.run_plan.remote("fp", {})
+    handle.run_plann.remote("fp", {})  # typo'd method: no class defines it
+    handle.run_plan.options(timeout=5.0).remote(
+        "fp", {}, None, "extra"  # 4 positionals: run_plan takes at most 3
+    )
+    handle.run_plan.remote("fp", binding={}, blob=None)  # unknown kwarg

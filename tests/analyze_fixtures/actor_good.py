@@ -1,0 +1,17 @@
+"""Fixed version of actor_bad.py: every dispatch names a real method and
+binds its signature."""
+
+
+class MiniExecutor:
+    def run_plan(self, program_id, binding, program_blob=None):
+        return binding
+
+    def ping(self):
+        return 0
+
+
+def client(handle):
+    handle.run_plan.remote("fp", {})
+    handle.run_plan.options(timeout=5.0).remote("fp", {}, None)
+    handle.run_plan.remote("fp", binding={}, program_blob=None)
+    handle.ping.remote()

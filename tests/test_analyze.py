@@ -52,6 +52,21 @@ def test_rpc_protocol_clean_on_fixed():
     assert run_rule("rpc-protocol", "rpc_good.py") == []
 
 
+def test_rpc_protocol_actor_plane_catches_seed():
+    """The actor-dispatch half of the rule: ``handle.<m>.remote(...)`` call
+    sites (incl. through ``.options(...)``) are checked against the
+    project-wide method inventory — covers run_plan/run_tasks/run_shuffle
+    and the SPMD worker ops."""
+    found = run_rule("rpc-protocol", "actor_bad.py")
+    messages = "\n".join(f.message for f in found)
+    assert "unknown actor method 'run_plann'" in messages
+    assert sum("actor arity mismatch" in f.message for f in found) == 2
+
+
+def test_rpc_protocol_actor_plane_clean_on_fixed():
+    assert run_rule("rpc-protocol", "actor_good.py") == []
+
+
 def test_swallowed_exceptions_catches_seed():
     found = run_rule("swallowed-exceptions", "swallowed_bad.py")
     assert len(found) == 2  # the pass handler and the continue handler

@@ -134,11 +134,19 @@ def test_last_query_stats_schema(traced_session):
     stats = traced_session.last_query_stats
     assert set(stats) == {
         "seconds", "output_partitions", "stages", "fusion", "shuffle",
+        "plan_cache", "rpc",
     }
     assert stats["seconds"] > 0
     assert stats["output_partitions"] >= 1
     assert stats["stages"], "at least one stage must be recorded"
     assert stats["shuffle"] == []  # narrow-only query: no exchange ran
+    # per-query control-plane accounting (the millisecond-control-plane
+    # numbers): plan-cache outcome + RPC round-trip counts
+    assert {"hits", "misses", "unsupported", "hit"} <= set(stats["plan_cache"])
+    assert {"head_rpcs", "actor_dispatches", "head_bypass_hits"} <= set(
+        stats["rpc"]
+    )
+    assert stats["rpc"]["actor_dispatches"] >= 1
     for stage in stats["stages"]:
         # per-stage schema: task count, wall seconds, locality + dispatch
         # mode, and the server-side read/compute/emit phase split
@@ -150,9 +158,10 @@ def test_last_query_stats_schema(traced_session):
             stage
         ), stage
         assert stage["dispatch"] in (
-            "per_task", "batched", "pipelined", "fused", "fused_failed"
+            "per_task", "batched", "pipelined", "fused", "fused_failed",
+            "compiled", "compiled_fused", "compiled_failed",
         )
-        if stage["dispatch"] in ("per_task", "batched"):
+        if stage["dispatch"] in ("per_task", "batched", "compiled"):
             assert "locality_preferred" in stage
         assert stage["tasks"] >= 1
         assert stage["seconds"] >= 0

@@ -133,8 +133,10 @@ def test_query_stats():
         assert stats["output_partitions"] >= 1
         if len(stats["stages"]) == 1:
             # single-executor pools ship the whole map→reduce graph as ONE
-            # fused dispatch — one stage covering both rounds
-            assert stats["stages"][0]["dispatch"] == "fused"
+            # fused dispatch — one stage covering both rounds ("fused" via
+            # run_shuffle on the legacy path, "compiled_fused" when the
+            # compiled-plan cache dispatched it through run_plan)
+            assert stats["stages"][0]["dispatch"] in ("fused", "compiled_fused")
         else:
             assert len(stats["stages"]) >= 2  # map + reduce
         assert all(s["tasks"] >= 1 for s in stats["stages"])

@@ -1,0 +1,233 @@
+"""Millisecond control plane: compiled-plan cache + whole-plan dispatch.
+
+Parity discipline mirrors PR 3's indexed-vs-legacy shuffle tests: every new
+path (plan cache, run_plan dispatch, head-bypass location pushing) has an A/B
+toggle and must produce byte-identical Arrow results against the legacy
+staged path. Plus the control-plane budgets the roadmap demands: a second
+execution of an identical query shape performs zero planning work and costs
+at most 2 head RPCs (asserted from ``last_query_stats``'s new counters).
+"""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+import raydp_tpu
+from raydp_tpu.etl import functions as F
+from raydp_tpu.store import object_store as store
+
+
+@pytest.fixture(scope="module")
+def session():
+    s = raydp_tpu.init_etl(
+        "test-plan-cache", num_executors=2, executor_cores=2,
+        executor_memory="300M",
+    )
+    yield s
+    raydp_tpu.stop_etl()
+
+
+def _pdf(n=300, seed=3):
+    rng = np.random.default_rng(seed)
+    return pd.DataFrame(
+        {
+            "a": np.arange(n, dtype=np.int64),
+            "k": rng.integers(0, 5, n),
+            "v": rng.random(n),
+        }
+    )
+
+
+def _ab(session, build):
+    """build() under the FULL legacy control plane (no cache, no compiled
+    dispatch, no head bypass) vs under the compiled one; returns both."""
+    planner = session._planner
+    saved = (planner.plan_cache, planner.compiled_dispatch, planner.head_bypass)
+    try:
+        planner.plan_cache = False
+        planner.compiled_dispatch = False
+        planner.head_bypass = False
+        store.set_location_cache(False)
+        legacy = build()
+        planner.plan_cache, planner.compiled_dispatch, planner.head_bypass = (
+            True, True, True,
+        )
+        store.set_location_cache(True)
+        compiled = build()
+        # and once more from the warm cache — cached-plan vs fresh-plan
+        cached = build()
+    finally:
+        (
+            planner.plan_cache, planner.compiled_dispatch, planner.head_bypass
+        ) = saved
+        store.set_location_cache(saved[2])
+    return legacy, compiled, cached
+
+
+def test_narrow_chain_ab_identical(session):
+    df = (
+        session.from_pandas(_pdf(), num_partitions=4)
+        .with_column("w", F.col("v") * 3.0)
+        .with_column("z", F.col("w") + F.col("a"))
+        .filter(F.col("k") > 1)
+        .select("a", "k", "z")
+    )
+    legacy, compiled, cached = _ab(session, df.to_arrow)
+    assert legacy.equals(compiled)
+    assert legacy.equals(cached)
+
+
+def test_exchange_shapes_ab_identical(session):
+    df = session.from_pandas(_pdf(), num_partitions=4)
+
+    shapes = {
+        "groupby": lambda: (
+            df.group_by("k")
+            .agg(F.sum("v").alias("sv"), F.count("*").alias("c"))
+            .to_arrow()
+            .sort_by("k")
+        ),
+        "repartition": lambda: df.repartition(3).to_arrow().sort_by("a"),
+        "distinct": lambda: (
+            df.select("k").distinct().to_arrow().sort_by("k")
+        ),
+        "window": lambda: (
+            df.with_column(
+                "rn", F.row_number().over(partition_by=["k"], order_by=["a"])
+            )
+            .to_arrow()
+            .sort_by("a")
+        ),
+    }
+    for name, build in shapes.items():
+        legacy, compiled, cached = _ab(session, build)
+        assert legacy.equals(compiled), name
+        assert legacy.equals(cached), name
+
+
+def test_second_execution_zero_planning_and_head_rpc_budget(session):
+    """The acceptance budget: an identical query shape re-executed performs
+    ZERO planning work (plan-cache hit, no misses) and costs ≤ 2 head RPCs
+    on the driver."""
+    df = (
+        session.from_pandas(_pdf(seed=11), num_partitions=4)
+        .with_column("b2", F.col("v") * 2.0)
+        .filter(F.col("k") < 4)
+    )
+    first = df.count()
+    warm_counts = []
+    for _ in range(2):
+        assert df.count() == first
+        stats = session.last_query_stats
+        assert stats["plan_cache"]["hit"] is True
+        assert stats["plan_cache"]["misses"] == 0
+        assert stats["rpc"]["head_rpcs"] <= 2, stats["rpc"]
+        # one whole-plan dispatch per executor, nothing else
+        assert stats["rpc"]["actor_dispatches"] <= len(session.executors)
+        warm_counts.append(stats["rpc"]["head_rpcs"])
+    # warm exchange too (groupby: map registrations happen executor-side;
+    # the driver pays at most locality + intermediate-delete round trips)
+    agg = df.group_by("k").agg(F.sum("v").alias("s"))
+    agg.count()
+    agg.count()
+    stats = session.last_query_stats
+    assert stats["plan_cache"]["hit"] is True
+    assert stats["rpc"]["head_rpcs"] <= 2, stats["rpc"]
+
+
+def test_literal_slots_rebind_without_recompile(session):
+    """Same query shape, different literal: the plan cache must HIT (the
+    literal is a parameter slot) and the result must reflect the NEW value."""
+    pdf = _pdf(seed=7)
+    df = session.from_pandas(pdf, num_partitions=4)
+
+    def q(cut):
+        return (
+            df.filter(F.col("a") < F.lit(cut))
+            .with_column("w", F.col("v") + F.lit(float(cut)))
+            .to_arrow()
+        )
+
+    t1 = q(50)
+    assert t1.num_rows == 50
+    t2 = q(120)
+    stats = session.last_query_stats
+    assert stats["plan_cache"]["hit"] is True, stats["plan_cache"]
+    assert t2.num_rows == 120
+    expect = pdf[pdf.a < 120]
+    assert np.allclose(
+        np.sort(t2.column("w").to_numpy()),
+        np.sort((expect.v + 120.0).to_numpy()),
+    )
+
+
+def test_invalidation_on_conf_flip_and_schema_change(session):
+    """A lowering-relevant conf flip and an input-schema change must each
+    RECOMPILE (cache miss), never serve the stale program."""
+    planner = session._planner
+    df = session.from_pandas(_pdf(seed=5), num_partitions=3)
+    build = lambda frame: frame.group_by("k").agg(  # noqa: E731
+        F.sum("v").alias("s")
+    ).to_arrow().sort_by("k")
+    base = build(df)
+    assert build(df).equals(base)
+    assert session.last_query_stats["plan_cache"]["hit"] is True
+    saved = planner.shuffle_indexed_blocks
+    try:
+        planner.shuffle_indexed_blocks = not saved
+        assert build(df).equals(base)  # conf flip → new fingerprint
+        stats = session.last_query_stats
+        assert stats["plan_cache"]["misses"] == 1, stats["plan_cache"]
+    finally:
+        planner.shuffle_indexed_blocks = saved
+    # schema change: same query text, float32 value column → recompile
+    pdf2 = _pdf(seed=5)
+    pdf2["v"] = pdf2["v"].astype(np.float32)
+    df2 = session.from_pandas(pdf2, num_partitions=3)
+    build(df2)
+    stats = session.last_query_stats
+    assert stats["plan_cache"]["misses"] == 1, stats["plan_cache"]
+
+
+def test_program_cache_miss_after_executor_restart(session):
+    """An executor restart drops its resident programs; the driver's next
+    warm dispatch gets ProgramCacheMiss and must re-ship the program body
+    transparently (same results, still a driver-side cache hit)."""
+    from raydp_tpu.cluster.common import ActorState
+
+    df = (
+        session.from_pandas(_pdf(seed=13), num_partitions=4)
+        .with_column("r", F.col("v") * 5.0)
+    )
+    import time
+
+    before = df.to_arrow()
+    victim = session.executors[0]
+    old_inc = victim._record().incarnation
+    victim.kill(no_restart=False)  # restartable kill: same identity returns
+    deadline = time.monotonic() + 60
+    while True:  # wait for the NEW incarnation to come up (kill is async)
+        record = victim._record()
+        if record.incarnation > old_inc and record.state == ActorState.ALIVE:
+            break
+        assert time.monotonic() < deadline, record
+        time.sleep(0.05)
+    after = df.to_arrow()
+    assert before.equals(after)
+    assert session.last_query_stats["plan_cache"]["hit"] is True
+
+
+def test_uncompilable_shapes_still_work(session):
+    """Joins/sorts/limits stay on the recursive driver: counted as
+    ``unsupported``, executed exactly as before."""
+    pdf = _pdf(seed=17)
+    df = session.from_pandas(pdf, num_partitions=3)
+    other = session.from_pandas(
+        pd.DataFrame({"k": np.arange(5), "name": [f"n{i}" for i in range(5)]}),
+        num_partitions=2,
+    )
+    joined = df.join(other, on=["k"]).to_arrow()
+    assert joined.num_rows == len(pdf)
+    stats = session.last_query_stats
+    assert stats["plan_cache"]["unsupported"] >= 1
+    assert stats["plan_cache"]["hits"] == 0

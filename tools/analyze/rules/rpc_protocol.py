@@ -21,6 +21,19 @@ This rule closes the loop statically:
   (no server's ``handle_<op>`` binds the provided kwargs), ``dead-handler``
   (a handler no statically-visible call site reaches; suppress on the def
   line for ops exercised only by tests or reflectively).
+
+The rule also inventories the **actor-dispatch plane** — the by-name half of
+``handle.<method>.remote(...)`` / ``handle.<method>.options(...).remote(...)``
+calls (the surface ``run_plan``/``run_tasks``/``run_shuffle`` and the SPMD
+worker ops ride). Handles are untyped (any spawned class), so the op
+inventory is every method defined on any project class: a dispatched method
+name no class defines is an ``unknown actor method`` finding, and when
+exactly ONE project class defines it, the call's positional/keyword shape
+must bind its signature (``actor arity mismatch``). The doorbell transport
+and the location-lease head op added by the compiled-plan control plane are
+covered by the same inventories (``object_lookup_lease`` via head_rpc;
+doorbell rides the existing actor plane — no new wire shapes escape the
+rule).
 """
 
 from __future__ import annotations
@@ -167,11 +180,138 @@ def _collect_call_sites(project: Project) -> List[_CallSite]:
     return sites
 
 
+@dataclasses.dataclass
+class _Method:
+    cls: str
+    required: List[str]
+    optional: List[str]
+    has_var_args: bool
+    has_var_kw: bool
+
+    def binds(self, n_pos: int, kwnames: Set[str]) -> bool:
+        params = list(self.required) + list(self.optional)
+        if not self.has_var_args and n_pos > len(params):
+            return False
+        positional = set(params[:n_pos])
+        if not self.has_var_kw and not kwnames <= set(params) - positional:
+            return False
+        return set(self.required) <= positional | kwnames
+
+    def signature(self) -> str:
+        parts = list(self.required) + [f"{o}=…" for o in self.optional]
+        if self.has_var_args:
+            parts.append("*a")
+        if self.has_var_kw:
+            parts.append("**kw")
+        return f"{self.cls}.({', '.join(parts)})"
+
+
+def _collect_class_methods(project: Project) -> Dict[str, List[_Method]]:
+    """Every method on every project class, by name — the actor-dispatch
+    plane's op inventory (handles are untyped, so the inventory is
+    project-wide; a name NO class defines is a typo'd dispatch)."""
+    methods: Dict[str, List[_Method]] = {}
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for m in node.body:
+                if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                args = m.args
+                names = [a.arg for a in args.args[1:]]  # drop self
+                n_def = len(args.defaults)
+                required = names[: len(names) - n_def] if n_def else list(names)
+                optional = names[len(names) - n_def:] if n_def else []
+                for a, d in zip(args.kwonlyargs, args.kw_defaults):
+                    (optional if d is not None else required).append(a.arg)
+                methods.setdefault(m.name, []).append(
+                    _Method(
+                        cls=node.name,
+                        required=required,
+                        optional=optional,
+                        has_var_args=args.vararg is not None,
+                        has_var_kw=args.kwarg is not None,
+                    )
+                )
+    return methods
+
+
+def _actor_dispatch_sites(project: Project):
+    """(method_name, n_positional, kwnames_or_None, src, node) for every
+    ``<expr>.<method>.remote(...)`` / ``<expr>.<method>.options(...).remote``
+    call. The receiver may be arbitrary (subscripts, attributes); only the
+    two trailing attribute hops name the op."""
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "remote"
+            ):
+                continue
+            inner = node.func.value
+            if (
+                isinstance(inner, ast.Call)
+                and isinstance(inner.func, ast.Attribute)
+                and inner.func.attr == "options"
+            ):
+                inner = inner.func.value
+            if not isinstance(inner, ast.Attribute):
+                continue  # e.g. a bare name called .remote on: not this plane
+            kwnames: Optional[Set[str]] = set()
+            for kw in node.keywords:
+                if kw.arg is None:  # **spread — shape unknowable
+                    kwnames = None
+                    break
+                kwnames.add(kw.arg)
+            n_pos = len(node.args)
+            if any(isinstance(a, ast.Starred) for a in node.args):
+                n_pos = -1  # *spread: positional count unknowable
+            yield inner.attr, n_pos, kwnames, src, node
+
+
 class RpcProtocolRule:
     name = "rpc-protocol"
 
-    def check_project(self, project: Project) -> List[Finding]:
+    def _check_actor_plane(self, project: Project) -> List[Finding]:
         findings: List[Finding] = []
+        methods = _collect_class_methods(project)
+        if not methods:
+            return findings
+        for name, n_pos, kwnames, src, node in _actor_dispatch_sites(project):
+            cands = methods.get(name)
+            if not cands:
+                findings.append(
+                    src.finding(
+                        self.name, node,
+                        f"unknown actor method '{name}': no project class "
+                        "defines it",
+                    )
+                )
+                continue
+            if len(cands) != 1 or n_pos < 0 or kwnames is None:
+                continue  # ambiguous target or spread args: arity unknowable
+            if not cands[0].binds(n_pos, kwnames):
+                sent = ", ".join(
+                    [f"<{n_pos} positional>"] + sorted(kwnames)
+                )
+                findings.append(
+                    src.finding(
+                        self.name, node,
+                        f"actor arity mismatch for '{name}': call sends "
+                        f"({sent}) but {name}{cands[0].signature()[len(cands[0].cls):]} "
+                        f"on {cands[0].cls} cannot bind it",
+                    )
+                )
+        return findings
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = self._check_actor_plane(project)
         handlers = _collect_handlers(project)
         sites = _collect_call_sites(project)
         if not handlers:

@@ -6,10 +6,15 @@ BENCH_* env vars), writes an artifact JSON holding the headline ETL numbers
 plus the full ``etl_breakdown`` and per-exchange shuffle stats, and FAILS
 when:
 
-- ``etl_query_s`` regresses more than 25% over the committed BENCH_r05
+- ``etl_query_s`` regresses more than 25% over the committed BENCH_r06
   snapshot's value (the CI slice runs ~10x fewer rows than the snapshot's
   run, so this is a smoke gate for gross regressions — a structural
   slowdown in the data plane, not a ±10% noise detector);
+- the interactive-burst p50 (``burst_p50_ms``) regresses more than 25% over
+  the snapshot — the millisecond-control-plane gate (plan cache + run_plan
+  dispatch + head bypass + doorbell all sit under this number);
+- the burst's repeated-query slice shows NO plan-cache hits (hit-rate must
+  be > 0: identical query shapes re-executed must not replan);
 - an indexed shuffle writes more blocks than map tasks (the M-not-M×R
   invariant of the pipelined shuffle data plane).
 
@@ -30,17 +35,24 @@ REGRESSION_BUDGET = 0.25  # fail above snapshot * (1 + budget)
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-def snapshot_etl_query_s() -> float | None:
-    """The committed r05 bench snapshot's NYCTaxi etl_query_s (the snapshot
+SNAPSHOT = "BENCH_r06.json"
+
+
+def _snapshot_value(key: str) -> float | None:
+    """A headline number from the committed bench snapshot (the snapshot
     stores the bench stdout tail; first occurrence is the NYCTaxi slice)."""
-    path = os.path.join(REPO, "BENCH_r05.json")
+    path = os.path.join(REPO, SNAPSHOT)
     try:
         with open(path) as f:
             tail = json.load(f).get("tail", "")
     except (OSError, ValueError):
         return None
-    found = re.search(r'"etl_query_s": ([0-9.]+)', tail)
+    found = re.search(rf'"{key}": ([0-9.]+)', tail)
     return float(found.group(1)) if found else None
+
+
+def snapshot_etl_query_s() -> float | None:
+    return _snapshot_value("etl_query_s")
 
 
 def run_bench() -> dict:
@@ -51,6 +63,7 @@ def run_bench() -> dict:
     env.setdefault("BENCH_SAMPLES", "1")
     env.setdefault("BENCH_EPOCHS", "4")
     env.setdefault("BENCH_DLRM_EPOCHS", "4")
+    env.setdefault("BENCH_BURST", "200")
     out = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py")],
         capture_output=True, text=True, timeout=1800, env=env, cwd=REPO,
@@ -68,6 +81,10 @@ def main() -> int:
     reference = snapshot_etl_query_s()
     artifact = {
         "etl_query_s": detail["etl_query_s"],
+        "burst_p50_ms": detail.get("burst_p50_ms"),
+        "burst_p99_ms": detail.get("burst_p99_ms"),
+        "plan_cache_hit_rate": detail.get("plan_cache_hit_rate"),
+        "burst_last_query": detail.get("burst_last_query", {}),
         "pandas_etl_s": detail["pandas_etl_s"],
         "cluster_boot_s": detail["cluster_boot_s"],
         "streaming_vs_scan": detail["streaming_vs_scan"],
@@ -75,6 +92,7 @@ def main() -> int:
         "etl_breakdown": detail.get("etl_breakdown", {}),
         "shuffle_probe": detail.get("shuffle_probe", {}),
         "reference_etl_query_s": reference,
+        "reference_burst_p50_ms": _snapshot_value("burst_p50_ms"),
         "regression_budget": REGRESSION_BUDGET,
         "rows": detail.get("rows"),
     }
@@ -91,6 +109,21 @@ def main() -> int:
                 f"{limit:.3f}s (snapshot {reference:.3f}s + "
                 f"{REGRESSION_BUDGET:.0%})"
             )
+    burst_ref = artifact["reference_burst_p50_ms"]
+    burst_p50 = artifact["burst_p50_ms"]
+    if burst_ref is not None and burst_p50 is not None:
+        limit = burst_ref * (1.0 + REGRESSION_BUDGET)
+        if burst_p50 > limit:
+            failures.append(
+                f"burst_p50_ms {burst_p50:.2f} exceeds {limit:.2f} "
+                f"(snapshot {burst_ref:.2f} + {REGRESSION_BUDGET:.0%})"
+            )
+    hit_rate = artifact["plan_cache_hit_rate"]
+    if hit_rate is not None and hit_rate <= 0.0:
+        failures.append(
+            "plan-cache hit-rate is 0 on the repeated-query burst slice "
+            "(identical query shapes re-executed must not replan)"
+        )
     for entry in artifact["shuffle_probe"].get("shuffle", []):
         if entry.get("indexed") and entry["blocks"] > entry["map_tasks"]:
             failures.append(
