@@ -113,12 +113,15 @@ from raydp_tpu.exchange.features import f_stack as _f_stack
 from raydp_tpu.exchange.features import fmap as _fmap
 
 
-def _put_stacked_batch(mesh, arr):
+def _put_stacked_batch(mesh, arr, shard_direct=True):
     """Upload recipe shared by the scan and stream runners — delegates to
-    the exchange layer's one implementation of the placement rules."""
+    the exchange layer's one implementation of the placement rules
+    (Partitioner.shard_stacked via jax_io)."""
     from raydp_tpu.exchange.jax_io import device_put_stacked
 
-    return _fmap(lambda a: device_put_stacked(a, mesh), arr)
+    return _fmap(
+        lambda a: device_put_stacked(a, mesh, shard_direct=shard_direct), arr
+    )
 
 
 def _compile_span(what):
@@ -227,6 +230,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         stream_scan_steps: int = 32,
         stream_prefetch_segments: int = 3,
         keep_checkpoints: Optional[int] = None,
+        shard_direct: bool = True,
+        stream_wire_quant: Union[bool, str] = False,
+        stream_executor_decode: bool = True,
     ):
         self._model_arg = model
         self._optimizer_arg = optimizer
@@ -326,6 +332,25 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         # retention: keep only the newest N epoch checkpoints (each is a full
         # params+opt_state copy). None keeps everything.
         self.keep_checkpoints = keep_checkpoints
+        # shard-direct feeds (Partitioner.shard_inputs): batches reach the
+        # mesh via make_array_from_process_local_data — each process uploads
+        # only its shard. False restores the legacy driver-staged sharded
+        # device_put (the A/B arm; byte-identical results, but multi-host it
+        # stages the global batch per process).
+        self.shard_direct = bool(shard_direct)
+        # mixed-dtype ON-WIRE staging for streaming fits: float feature
+        # leaves are staged int8 with per-row scales and widened back to
+        # float INSIDE the jitted segment scan (~3.2x fewer H2D bytes per
+        # dense leaf; integer id leaves always ride exact int32 — any vocab
+        # size). Lossy by construction (int8 rounding), so OFF by default;
+        # accepts True (alias for "int8") or "int8".
+        self.stream_wire_quant = stream_wire_quant
+        # streaming segment decode (Arrow block -> numpy) runs in the etl
+        # EXECUTOR processes when the dataset's session is still alive —
+        # the consumer thread only sequences uploads. Falls back to
+        # driver-side decode when the session is stopped or an executor
+        # call fails.
+        self.stream_executor_decode = bool(stream_executor_decode)
 
         self._module = None
         self._params = None
@@ -720,7 +745,13 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         self._history = []
         self.compile_seconds_ = init_compile
         first_step_done = False
-        with profile_ctx, mesh:
+        # the ExitStack is entered FIRST so its callbacks run LAST: the
+        # streaming pipeline's close (registered below once the runner
+        # exists) must stop/drain/join the whole-fit producer on ANY exit —
+        # a consumer exception abandoning a producer parked on the full
+        # queue would leak the thread and pin its in-flight device segments
+        # (the leaks sanitizer audits exactly this at shutdown)
+        with contextlib.ExitStack() as _fit_stack, profile_ctx, mesh:
             run_scan_epoch, run_fullfit = self._build_scan_runner(
                 train_source, batch_size, mesh, step_impl, donate
             )
@@ -740,6 +771,43 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
             def save_mid_epoch(params_, opt_state_, epoch_, step_):
                 self._save_checkpoint(params_, epoch_, opt_state_, step=step_)
+
+            if run_stream_segments is not None:
+                # whole-fit streaming pipeline: ONE producer covers every
+                # epoch (epoch N+1's first segment decodes while epoch N's
+                # tail trains); each epoch's host iterator is built lazily
+                # by this plan when the producer reaches it
+                seg_steps = self._stream_segment_steps
+
+                def _stream_epoch_plan(epoch_):
+                    epoch_seed_ = None if not self.shuffle else self.seed + epoch_
+                    epoch_start_ = start_step if epoch_ == start_epoch else 0
+                    coalesced_ = epoch_start_ % seg_steps == 0
+                    base_iter_ = self._epoch_batches(
+                        train_source, batch_size, epoch_seed_,
+                        segment_rows=(
+                            seg_steps * batch_size if coalesced_ else None
+                        ),
+                    )
+                    host_iter_ = base_iter_
+                    if epoch_start_:
+                        import itertools
+
+                        skip = (
+                            epoch_start_ // seg_steps
+                            if coalesced_
+                            else epoch_start_
+                        )
+                        host_iter_ = itertools.islice(host_iter_, skip, None)
+                    # base_iter_ rides along unwrapped: the executor-decode
+                    # evidence flag lives on the block-stream iterator, which
+                    # an islice wrapper (mid-epoch resume) would hide
+                    return host_iter_, coalesced_, base_iter_
+
+                run_stream_segments.start(
+                    _stream_epoch_plan, range(start_epoch, self.num_epochs)
+                )
+                _fit_stack.callback(run_stream_segments.close)
 
             # whole-fit fast path: when nothing needs params BETWEEN epochs
             # (no checkpointing, no per-epoch eval, no resume), the entire
@@ -799,37 +867,18 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             ),
                         )
                     elif run_stream_segments is not None:
-                        # coalesced fast path: pull whole segments as one
-                        # contiguous slice each (checkpoint resumes land on
-                        # segment boundaries by construction — seg divides
-                        # save_every_steps; anything else falls back to the
-                        # batch-granular producer)
-                        seg_steps = self._stream_segment_steps
-                        coalesced = epoch_start_step % seg_steps == 0
-                        host_iter = self._epoch_batches(
-                            train_source, batch_size, epoch_seed,
-                            segment_rows=(
-                                seg_steps * batch_size if coalesced else None
-                            ),
-                        )
-                        if epoch_start_step:
-                            import itertools
-
-                            skip = (
-                                epoch_start_step // seg_steps
-                                if coalesced
-                                else epoch_start_step
-                            )
-                            host_iter = itertools.islice(host_iter, skip, None)
+                        # consume this epoch's segments off the whole-fit
+                        # pipeline (the producer, started before the loop,
+                        # builds each epoch's host iterator itself —
+                        # coalesced whole-segment slices except on a
+                        # mid-segment resume)
                         params, opt_state, loss_sum, steps = run_stream_segments(
-                            params, opt_state, host_iter, epoch_start_step,
+                            params, opt_state, epoch, epoch_start_step,
                             save_cb=(
                                 (lambda p, o, s, _e=epoch: save_mid_epoch(p, o, _e, s))
                                 if save_steps
                                 else None
                             ),
-                            epoch=epoch,
-                            coalesced=coalesced,
                         )
                     else:
                         host_iter = self._epoch_batches(
@@ -844,7 +893,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             host_iter = itertools.islice(
                                 host_iter, epoch_start_step, None
                             )
-                        train_iter = PrefetchingDeviceIterator(host_iter, mesh)
+                        train_iter = PrefetchingDeviceIterator(
+                            host_iter, mesh, shard_direct=self.shard_direct
+                        )
                         loss_sum = jnp.zeros((), jnp.float32)
                         steps = epoch_start_step
                         pending_save = None
@@ -968,15 +1019,24 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         deferred until the next segment begins, so a checkpoint always has
         tail steps to replay.
 
-        Segments are pipelined ``stream_prefetch_segments`` deep: a
-        producer thread reads blocks, shapes segment N+k, and starts its
-        H2D upload while segment N's scan is still executing — block IO and
-        transfer overlap compute instead of serializing with it. On the
-        (default) coalesced path the host iterator yields whole segments as
-        one contiguous slice and the producer just reshapes it
+        Segments are pipelined ``stream_prefetch_segments`` deep through
+        N-way rotating upload streams: ONE producer thread lives for the
+        WHOLE fit (not per epoch), reads blocks, shapes segments, and
+        starts their H2D uploads while earlier segments' scans are still
+        executing — and at an epoch boundary it rolls straight into the
+        next epoch's first segment, so the consumer never waits out a
+        decode ramp between epochs (the per-epoch producer restart used to
+        cost ~a first-segment decode of consumer idle EVERY epoch). On the
+        (default) coalesced path the host iterator yields whole segments
+        as one contiguous slice and the producer just reshapes it
         ([S·B, ...] → [S, B, ...], zero-copy) — the per-batch Python loop
         and the np.stack copy per segment exist only on the legacy
-        batch-granular path (mid-segment resume)."""
+        batch-granular path (mid-segment resume).
+
+        With ``stream_wire_quant`` float feature leaves travel the wire
+        int8 + per-row scales and are widened back INSIDE the jitted scan
+        (see jax_io's wire-staging helpers); integer id leaves always ride
+        exact int32."""
         import queue
         import threading
 
@@ -1002,19 +1062,105 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         self._stream_segment_steps = seg
         compiled: Dict[int, Any] = {}
 
+        from raydp_tpu.exchange.jax_io import (
+            SegmentUploader,
+            iter_prefetch,
+            partitioner_for,
+            quantize_rows,
+            widen_wire,
+        )
+
+        # -- mixed-dtype wire spec (static for the whole fit) --------------
+        # which feature leaves quantize: float leaves only; integer id
+        # leaves already ride the wire exact (int32 feature_groups)
+        groups = self._feature_groups()
+        leaf_dtypes = (
+            [np.dtype(self.feature_dtype)]
+            if groups is None
+            else [np.dtype(dt) for _, dt in groups]
+        )
+        wire_dtype = None
+        if self.stream_wire_quant:
+            wire_dtype = (
+                "int8"
+                if self.stream_wire_quant is True
+                else str(self.stream_wire_quant)
+            )
+            if wire_dtype != "int8":
+                raise ValueError(
+                    f"stream_wire_quant={self.stream_wire_quant!r}: only "
+                    "'int8' (or True) is supported"
+                )
+        wire_flags = [
+            wire_dtype is not None and np.issubdtype(dt, np.floating)
+            for dt in leaf_dtypes
+        ]
+        wire_on = any(wire_flags)
+        single_leaf = groups is None
+
+        def _wire_encode(hx):
+            """Host half of the wire format: each float leaf becomes
+            (int8 q, float32 per-row scale); the wire container is a FLAT
+            tuple ``(leaves..., scales...)`` of plain arrays, so the
+            uploader's staging/ping-pong machinery needs no special cases."""
+            leaves = list(hx) if isinstance(hx, tuple) else [hx]
+            wire, scales = [], []
+            for leaf, flag in zip(leaves, wire_flags):
+                if flag:
+                    q, s = quantize_rows(np.asarray(leaf))
+                    wire.append(q)
+                    scales.append(s)
+                else:
+                    wire.append(np.asarray(leaf))
+            return tuple(wire + scales)
+
+        def _wire_widen(x):
+            """Device half, traced INSIDE the jitted scan body: widen each
+            quantized leaf back to its model dtype (bit-identical to the
+            host dequant) and rebuild the model's feature container."""
+            nf = len(wire_flags)
+            scales = list(x[nf:])
+            out, si = [], 0
+            for leaf, flag, dt in zip(x[:nf], wire_flags, leaf_dtypes):
+                if flag:
+                    out.append(widen_wire(leaf, scales[si], dt))
+                    si += 1
+                else:
+                    out.append(leaf)
+            return out[0] if single_leaf else tuple(out)
+
+        if wire_on:
+            # widen PER STEP inside the scan: only one batch's float copy
+            # ever materializes, and XLA fuses the dequant into the step
+            def _wire_step(p, o, ls, x, y):
+                return step_impl(p, o, ls, _wire_widen(x), y)
+
+            scan_step = _wire_step
+        else:
+            scan_step = step_impl
+
         def epoch_body(params, opt_state, xb, yb):
-            return _scan_over_batches(step_impl, params, opt_state, xb, yb)
+            return _scan_over_batches(scan_step, params, opt_state, xb, yb)
 
-        jitted = partial_jit(donate_argnums=(0, 1) if donate else ())(epoch_body)
+        # the streaming runner's feeds AND its step jit ride the same
+        # partitioner: shard_stacked places the segments, partition_step
+        # (== partial_jit's checked_jit chain) jits the scan body
+        partitioner = partitioner_for(mesh, "data", self.shard_direct)
+        jitted = partitioner.partition_step(
+            epoch_body, donate_argnums=(0, 1) if donate else ()
+        )
 
-        from raydp_tpu.exchange.jax_io import SegmentUploader, iter_prefetch
-
-        # double-buffered upload staging: two reusable host buffers feed the
-        # async transfers (ping-pong recycled only after the transfer that
-        # used them completed); automatically degrades to per-segment
-        # allocation on CPU jax, where device_put zero-copy ALIASES host
-        # numpy buffers and reuse would corrupt the in-flight segment
-        uploader = SegmentUploader(mesh, depth=2)
+        # N-way ping-pong upload staging: ``stream_prefetch_segments``
+        # rotating host buffers feed the async transfers (each recycled only
+        # after the transfer that used it completed); automatically degrades
+        # to per-segment allocation on CPU jax, where device_put zero-copy
+        # ALIASES host numpy buffers and reuse would corrupt an in-flight
+        # segment
+        uploader = SegmentUploader(
+            mesh,
+            depth=max(2, self.stream_prefetch_segments),
+            partitioner=partitioner,
+        )
         stats = self.stream_stats_ = {
             "bytes_uploaded": 0,
             "producer_idle_s": 0.0,
@@ -1023,21 +1169,37 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             "cached_epochs": 0,
             "staging_buffer_reuse": uploader.reuse_host_buffers,
             "staging_copies": 0,
+            "upload_streams": uploader.upload_streams,
+            "shard_direct": self.shard_direct,
+            "wire_dtype": wire_dtype if wire_on else None,
+            "wire_bytes_saved": 0,
+            "executor_decode": False,
         }
 
-        def _produce_segments(host_iter, out_q: "queue.Queue", stop, coalesced):
-            """Producer thread: shape each segment and START its device
-            upload; the bounded queue (depth = stream_prefetch_segments)
+        def _produce_fit(epoch_plan, epochs, out_q: "queue.Queue", stop):
+            """THE producer thread — one per fit, streaming every epoch
+            back to back: shape each segment, START its device upload, and
+            at an epoch boundary roll straight into the next epoch's blocks
+            (the next epoch's first segment decodes while the current
+            epoch's tail is still training — the per-epoch producer restart
+            used to hand the consumer a decode-ramp stall every epoch).
+            Items are a (dx, dy) segment, ``None`` for epoch end, or an
+            exception to re-raise consumer-side (epochs are consumed
+            strictly in production order, so no per-item epoch tag is
+            needed). The bounded queue (depth = stream_prefetch_segments)
             applies backpressure so only that many segments' worth of
-            host/device memory is in flight. ``stop`` lets a failing
-            consumer unblock a producer parked on the full queue — an
-            abandoned thread would pin the in-flight device segments
-            forever. ``coalesced``: items are whole-segment slices
-            (reshaped zero-copy); otherwise per-batch items are stacked.
-            The host iterator is itself prefetched one segment deep
-            (``iter_prefetch``), so segment k+1 DECODES while segment k's
-            async device_put is in flight — block IO, staging copy, and
-            transfer all overlap."""
+            host/device memory is in flight; ``stop`` lets a failing
+            consumer unblock a producer parked on the full queue.
+            ``epoch_plan(epoch)`` returns that epoch's ``(host_iter,
+            coalesced, block_iter)`` (block_iter = the unwrapped
+            block-stream iterator carrying the executor-decode evidence
+            flag) — coalesced
+            items are whole-segment slices (reshaped zero-copy), per-batch
+            items are stacked (mid-segment resume only). The host iterator
+            is itself prefetched one segment deep (``iter_prefetch``), so
+            segment k+1 DECODES while segment k's async device_put is in
+            flight — block IO, wire encode, staging copy, and transfer all
+            overlap."""
 
             def _emit(item) -> bool:
                 from raydp_tpu import obs
@@ -1060,8 +1222,12 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             def _upload(hx, hy):
                 from raydp_tpu import obs
 
+                logical = _f_nbytes(hx) + hy.nbytes
+                if wire_on:
+                    hx = _wire_encode(hx)
                 nbytes = _f_nbytes(hx) + hy.nbytes
                 stats["bytes_uploaded"] += nbytes
+                stats["wire_bytes_saved"] += max(0, logical - nbytes)
                 stats["segments"] += 1
                 obs.metrics.counter("estimator.stream.bytes_uploaded").inc(
                     nbytes
@@ -1072,31 +1238,60 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 return dx, dy
 
             try:
-                if coalesced:
-                    from raydp_tpu.exchange.jax_io import coalesce_segment
-
-                    for x, y in iter_prefetch(host_iter, depth=1):
-                        hx, hy, k = coalesce_segment(
-                            x, np.asarray(y), batch_size
-                        )
-                        if k == 0:
-                            continue  # sub-batch tail: drop_last semantics
-                        if not _emit(_upload(hx, hy)):
-                            return
-                else:
-                    xs: List[Any] = []
-                    ys: List[np.ndarray] = []
-                    for x, y in iter_prefetch(host_iter, depth=1):
-                        xs.append(_fmap(np.asarray, x))
-                        ys.append(np.asarray(y))
-                        if len(xs) == seg:
-                            if not _emit(_upload(_f_stack(xs), np.stack(ys))):
+                for epoch_ in epochs:
+                    if stop.is_set():
+                        return
+                    if (
+                        hybrid_gate is not None
+                        and not hybrid_gate.is_set()
+                        and epoch_ != epochs[0]
+                    ):
+                        # hybrid, decision pending: epoch 1 usually seals the
+                        # device cache and every later epoch replays it —
+                        # running ahead would upload segments only to throw
+                        # them away. Hold at the boundary until the consumer
+                        # rules (sealed → exit; overflow/resume → stream on).
+                        while not hybrid_gate.wait(0.2):
+                            if stop.is_set():
                                 return
-                            xs, ys = [], []
-                    if xs:
-                        if not _emit(_upload(_f_stack(xs), np.stack(ys))):
-                            return
-                _emit(None)
+                    if cache is not None and cache_ready["ok"]:
+                        # hybrid: everything from here on replays the device
+                        # cache — no more host IO to do
+                        return
+                    host_iter, coalesced, block_iter = epoch_plan(epoch_)
+                    if coalesced:
+                        from raydp_tpu.exchange.jax_io import coalesce_segment
+
+                        for x, y in iter_prefetch(host_iter, depth=1):
+                            hx, hy, k = coalesce_segment(
+                                x, np.asarray(y), batch_size
+                            )
+                            if k == 0:
+                                continue  # sub-batch tail: drop_last semantics
+                            if not _emit(_upload(hx, hy)):
+                                return
+                    else:
+                        xs: List[Any] = []
+                        ys: List[np.ndarray] = []
+                        for x, y in iter_prefetch(host_iter, depth=1):
+                            xs.append(_fmap(np.asarray, x))
+                            ys.append(np.asarray(y))
+                            if len(xs) == seg:
+                                if not _emit(
+                                    _upload(_f_stack(xs), np.stack(ys))
+                                ):
+                                    return
+                                xs, ys = [], []
+                        if xs:
+                            if not _emit(
+                                _upload(_f_stack(xs), np.stack(ys))
+                            ):
+                                return
+                    stats["executor_decode"] = stats["executor_decode"] or bool(
+                        getattr(block_iter, "executor_decode_active", False)
+                    )
+                    if not _emit(None):
+                        return
             except BaseException as exc:  # noqa: BLE001 - surface in consumer
                 _emit(exc)
 
@@ -1107,6 +1302,10 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         hybrid = self.streaming == "hybrid"
         cache: Optional[List[Any]] = [] if hybrid else None
         cache_ready = {"ok": False}
+        # set once the consumer has ruled on the device cache (sealed OR
+        # abandoned): until then the producer holds at epoch boundaries —
+        # see _produce_fit
+        hybrid_gate = threading.Event() if hybrid else None
 
         def _device_cache_budget() -> int:
             budget = self.stream_cache_memory_limit or self.scan_memory_limit
@@ -1123,44 +1322,75 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
         cache_budget = _device_cache_budget() if hybrid else 0
 
-        def run(params, opt_state, host_iter, start_step, save_cb=None,
-                epoch=0, coalesced=False):
+        # the whole-fit pipeline: one queue + one producer thread, started
+        # once by _fit_once before the epoch loop and closed in its finally
+        pipe: Dict[str, Any] = {"q": None, "stop": None, "thread": None}
+
+        def start(epoch_plan, epochs):
+            """Spawn the whole-fit producer (idempotent; one per fit)."""
+            if pipe["thread"] is not None:
+                return
+            pipe["q"] = queue.Queue(maxsize=self.stream_prefetch_segments)
+            pipe["stop"] = threading.Event()
+            pipe["thread"] = threading.Thread(
+                target=_produce_fit,
+                args=(epoch_plan, list(epochs), pipe["q"], pipe["stop"]),
+                daemon=True,
+            )
+            pipe["thread"].start()
+
+        def close():
+            """Stop + drain + join the producer. A failing (or cache-served)
+            consumer must not abandon a producer parked on the full queue —
+            it would pin ``stream_prefetch_segments`` device segments
+            forever."""
+            thread = pipe["thread"]
+            if thread is None:
+                return
+            pipe["stop"].set()
+            while True:
+                try:
+                    pipe["q"].get_nowait()
+                except queue.Empty:  # raydp-lint: disable=swallowed-exceptions (queue drain at shutdown)
+                    break
+            thread.join(timeout=10)
+            pipe["thread"] = None
+
+        def run(params, opt_state, epoch, start_step, save_cb=None):
             nonlocal cache
             if cache is not None and not cache_ready["ok"] and start_step != 0:
                 # a resumed (partial) epoch must not become the cache: later
                 # epochs would silently replay only its tail
                 cache = None
             if cache is not None and cache_ready["ok"] and start_step == 0:
+                # hybrid steady state: replay the device cache. The producer
+                # may have run ahead into this epoch before the cache sealed
+                # — close it now so its prefetched segments don't sit pinned
+                # behind a full queue for the rest of the fit
+                close()
                 return _run_cached(params, opt_state, epoch)
+            if pipe["thread"] is None:
+                raise RuntimeError(
+                    "stream pipeline not started (run.start was not called)"
+                )
             done = start_step
             loss_total = jnp.zeros((), jnp.float32)
-            seg_q: "queue.Queue" = queue.Queue(
-                maxsize=self.stream_prefetch_segments
-            )
-            stop = threading.Event()
-            producer = threading.Thread(
-                target=_produce_segments,
-                args=(host_iter, seg_q, stop, coalesced),
-                daemon=True,
-            )
-            producer.start()
             try:
                 params, opt_state, loss_total, done = _consume(
-                    params, opt_state, loss_total, done, seg_q, save_cb
+                    params, opt_state, loss_total, done, epoch, save_cb
                 )
                 if cache is not None and start_step == 0:
                     cache_ready["ok"] = True  # one FULL epoch pinned
             finally:
-                # a failing consumer must not abandon a producer parked on
-                # the full queue (it would pin two device segments forever)
-                stop.set()
-                while True:
-                    try:
-                        seg_q.get_nowait()
-                    except queue.Empty:  # raydp-lint: disable=swallowed-exceptions (queue drain at shutdown)
-                        break
-                producer.join(timeout=10)
+                if hybrid_gate is not None:
+                    # the cache ruling for this epoch is in (sealed,
+                    # abandoned, or the fit is failing): unblock a producer
+                    # holding at the boundary either way
+                    hybrid_gate.set()
             return params, opt_state, loss_total, done - start_step
+
+        run.start = start
+        run.close = close
 
         def _run_cached(params, opt_state, epoch):
             """Hybrid later-epoch path: scan the pinned device segments —
@@ -1204,11 +1434,12 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 loss_total = jnp.zeros((), jnp.float32)
             return params, opt_state, loss_total, done
 
-        def _consume(params, opt_state, loss_total, done, seg_q, save_cb):
+        def _consume(params, opt_state, loss_total, done, epoch, save_cb):
             nonlocal cache
             pending_save = None
             dispatches = 0
             cache_bytes = 0
+            seg_q = pipe["q"]
             from raydp_tpu import obs
 
             while True:
@@ -1221,7 +1452,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     idle
                 )
                 if item is None:
-                    break
+                    break  # this epoch's end sentinel (strict production order)
                 if isinstance(item, BaseException):
                     raise item
                 xb, yb = item
@@ -1404,9 +1635,12 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                         ),
                         feats,
                     ),
+                    shard_direct=self.shard_direct,
                 )
                 yb = _put_stacked_batch(
-                    mesh, labs[sel].reshape((length, batch_size) + labs.shape[1:])
+                    mesh,
+                    labs[sel].reshape((length, batch_size) + labs.shape[1:]),
+                    shard_direct=self.shard_direct,
                 )
                 if length not in compiled:
                     with _compile_span(length) as cspan:
@@ -1533,6 +1767,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             feature_dtype=self.feature_dtype, label_dtype=self.label_dtype,
             streaming=True, block_plan=plan,
             feature_groups=self._feature_groups(),
+            executor_decode=self.stream_executor_decode,
         )
 
     def _make_eval_step(self, module, loss_fn):
@@ -1650,7 +1885,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 )
         else:
             for x, y in PrefetchingDeviceIterator(
-                self._epoch_batches(source, batch_size, None, shuffle=False), mesh
+                self._epoch_batches(source, batch_size, None, shuffle=False),
+                mesh, shard_direct=self.shard_direct,
             ):
                 mstate, loss_sum, count = eval_step(
                     params, mstate, loss_sum, count, x, y

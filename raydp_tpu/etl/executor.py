@@ -229,6 +229,30 @@ class EtlExecutor:
         self._ship_telemetry()
         return result
 
+    def decode_segment(
+        self, ref, start: int, stop: int, feature_groups, label_column,
+        label_dtype,
+    ):
+        """Streaming-ingest segment decode (Arrow → numpy) in THIS process:
+        the training driver's block-stream iterator dispatches the per-span
+        decode here so its consumer thread only sequences uploads (the
+        executor reads the block shm-local; the decoded arrays ride the RPC
+        reply). See ``tasks.decode_segment``."""
+        from raydp_tpu import obs
+
+        with obs.collect():
+            with obs.span(
+                "executor.decode", executor=self.executor_id,
+                rows=max(0, int(stop) - int(start)),
+            ):
+                out = T.decode_segment(
+                    ref, start, stop, feature_groups, label_column,
+                    label_dtype,
+                )
+        obs.metrics.counter("etl.decode_tasks").inc()
+        self._ship_telemetry()
+        return out
+
     # -- data plane (exchange layer reads, SURVEY.md §3.6 analog) --
 
     def get_block_ipc(self, ref) -> bytes:

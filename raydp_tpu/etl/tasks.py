@@ -108,6 +108,36 @@ def read_table_block_slice(
     return pa.Table.from_batches(batches, schema=schema)
 
 
+def decode_segment(
+    ref: store.ObjectRef,
+    start: int,
+    stop: int,
+    feature_groups,
+    label_column: Optional[str],
+    label_dtype,
+):
+    """Streaming-ingest decode, run EXECUTOR-side: Arrow block (row span
+    ``[start, stop)``) → one numpy matrix per ``(columns, dtype)`` feature
+    group + the label vector. This is the per-segment CPU work (column
+    stacking, dtype casts, null checks) the training driver's consumer
+    thread used to pay inline; as an executor task it runs where the block
+    lives (shm-local read) and the driver only sequences uploads. Returns
+    ``(parts, labels)`` — ``None`` when the span is empty."""
+    # lazy: exchange imports tasks at module load; the converter is the ONE
+    # implementation both driver- and executor-side decode share
+    from raydp_tpu.exchange.dataset import _table_to_numpy_grouped
+
+    table = read_table_block(ref)
+    if start != 0 or stop != table.num_rows:
+        table = table.slice(start, stop - start)
+    if table.num_rows == 0:
+        return None
+    feats, labels = _table_to_numpy_grouped(
+        table, feature_groups, label_column, label_dtype
+    )
+    return list(feats), labels
+
+
 # Indexed shuffle block layout (one object per MAP TASK, not per split):
 #
 #   [split 0 IPC stream][split 1 IPC stream]...[split R-1 IPC stream]

@@ -300,6 +300,7 @@ class Dataset:
         streaming: bool = False,
         block_plan: Optional[List[Tuple[int, int, int]]] = None,
         feature_groups: Optional[Sequence[Tuple[Sequence[str], Any]]] = None,
+        executor_decode: bool = True,
     ) -> Iterator[Tuple[np.ndarray, Optional[np.ndarray]]]:
         """Batches of (features [B, F], labels [B]).
 
@@ -315,12 +316,17 @@ class Dataset:
         ``feature_groups`` (overrides feature_columns/feature_dtype): stage
         one matrix per (columns, dtype) group — batches yield a TUPLE of
         feature arrays (the mixed-dtype path).
+        ``executor_decode`` (streaming only, default on): when the dataset's
+        ETL session is still alive, per-span Arrow→numpy decode runs in the
+        session's EXECUTOR processes instead of this one (graceful local
+        fallback when the session is stopped or an executor dies).
         """
         if streaming:
             return StreamingBatchIterator(
                 self, batch_size, feature_columns, label_column,
                 shuffle, seed, drop_last, feature_dtype, label_dtype,
                 block_plan=block_plan, feature_groups=feature_groups,
+                executor_decode=executor_decode,
             )
         return self._iter_batches_staged(
             batch_size, feature_columns, label_column, shuffle, seed,
@@ -510,6 +516,15 @@ class StreamingBatchIterator:
 
     ``block_plan`` optionally restricts the pass to ``(block, start, stop)``
     spans (see ``streaming_shard_plan``) — the multi-process shard path.
+
+    ``executor_decode`` (default on): with a live ETL session, the per-span
+    Arrow→numpy decode (column stacking, dtype casts, null checks) runs as
+    ``decode_segment`` calls on the session's EXECUTOR processes — pipelined
+    two spans deep, round-robin over the pool — and this thread only
+    receives ready arrays. Stopped session / dead executor falls back to
+    local decode mid-pass without losing a span;
+    ``executor_decode_active`` records whether any span actually decoded
+    remotely.
     """
 
     def __init__(
@@ -519,6 +534,7 @@ class StreamingBatchIterator:
         feature_dtype, label_dtype,
         block_plan: Optional[List[Tuple[int, int, int]]] = None,
         feature_groups: Optional[Sequence[Tuple[Sequence[str], Any]]] = None,
+        executor_decode: bool = True,
     ):
         self._ds = ds
         self._batch_size = batch_size
@@ -538,8 +554,22 @@ class StreamingBatchIterator:
             if feature_groups is not None
             else None
         )
+        self._executor_decode = bool(executor_decode)
+        self.executor_decode_active = False
         self._active_gen = None
         self.peak_staged_rows = 0
+
+    def _decode_handles(self):
+        """The live session's executor pool, or None (toggle off, no
+        session, stopped session — the post-``stop_etl`` training flow)."""
+        if not self._executor_decode:
+            return None
+        session = getattr(self._ds, "_session", None)
+        if session is None or getattr(session, "_stopped", True):
+            return None
+        planner = getattr(session, "_planner", None)
+        handles = list(getattr(planner, "executors", None) or [])
+        return handles or None
 
     def _total_rows(self) -> int:
         if self._block_plan is not None:
@@ -577,32 +607,94 @@ class StreamingBatchIterator:
 
         grouped = self._feature_groups is not None
 
+        # single- and mixed-dtype decode share ONE converter: the single-
+        # matrix mode is the 1-group case (and executor-side decode_segment
+        # speaks exactly this spec)
+        decode_groups = (
+            self._feature_groups
+            if grouped
+            else [(list(self._feature_columns), self._feature_dtype)]
+        )
+
+        def _decode_local(span):
+            bi, row_start, row_stop = span
+            table = ds.get_block(int(bi))
+            if row_start != 0 or row_stop != table.num_rows:
+                table = table.slice(row_start, row_stop - row_start)
+            if table.num_rows == 0:
+                return None
+            feats, labels = _table_to_numpy_grouped(
+                table, decode_groups, self._label_column, self._label_dtype
+            )
+            return list(feats), labels
+
+        def _decoded_spans():
+            """One (parts, labels) per span, in order. With a live executor
+            pool the decode runs EXECUTOR-side (``decode_segment``),
+            pipelined two spans deep and round-robined over the pool; any
+            dispatch/RPC failure downgrades to local decode mid-pass
+            without losing the failed span."""
+            from collections import deque
+
+            from raydp_tpu.obs import metrics
+
+            handles = self._decode_handles()
+            spans = [plan[int(oi)] for oi in order]
+            futures: "deque" = deque()
+            k = 0  # next span not yet dispatched (or, pool-less, not served)
+            served = 0
+            while served < len(spans):
+                if stop.is_set():
+                    return
+                if handles is not None:
+                    while k < len(spans) and len(futures) < 2:
+                        bi, row_start, row_stop = spans[k]
+                        try:
+                            futures.append((
+                                k,
+                                handles[k % len(handles)].decode_segment.remote(
+                                    ds.blocks[int(bi)], int(row_start),
+                                    int(row_stop), decode_groups,
+                                    self._label_column, self._label_dtype,
+                                ),
+                            ))
+                        except Exception:  # raydp-lint: disable=swallowed-exceptions (executor gone: downgrade to local decode)
+                            handles = None
+                            break
+                        k += 1
+                if futures:
+                    j, future = futures.popleft()
+                    try:
+                        item = future.result()
+                    except Exception:  # raydp-lint: disable=swallowed-exceptions (executor died mid-pass: redo this span locally)
+                        handles = None
+                        item = _decode_local(spans[j])
+                    else:
+                        self.executor_decode_active = True
+                        metrics.counter("exchange.executor_decode_spans").inc()
+                else:
+                    item = _decode_local(spans[k])
+                    k += 1
+                served += 1
+                if item is not None:
+                    yield item
+
         def producer():
             try:
-                for oi in order:
+                for item in _decoded_spans():
                     if stop.is_set():
                         return
-                    bi, row_start, row_stop = plan[int(oi)]
-                    table = ds.get_block(int(bi))
-                    if row_start != 0 or row_stop != table.num_rows:
-                        table = table.slice(row_start, row_stop - row_start)
-                    if table.num_rows == 0:
+                    staged.put(item)
+                # the sentinel must not park the thread forever: a stopped
+                # consumer drains at most ONE slot, and a stop-triggered
+                # early return from _decoded_spans lands here with the
+                # queue possibly full
+                while not stop.is_set():
+                    try:
+                        staged.put(None, timeout=0.2)
+                        return
+                    except queue.Full:  # raydp-lint: disable=swallowed-exceptions (bounded retry: re-check stop, then re-offer the sentinel)
                         continue
-                    if grouped:
-                        feats, labels = _table_to_numpy_grouped(
-                            table, self._feature_groups,
-                            self._label_column, self._label_dtype,
-                        )
-                        parts = list(feats)
-                    else:
-                        f, labels = _table_to_numpy(
-                            table, self._feature_columns,
-                            self._label_column, self._feature_dtype,
-                            self._label_dtype,
-                        )
-                        parts = [f]
-                    staged.put((parts, labels))
-                staged.put(None)
             except BaseException as e:  # surface in the consumer
                 staged.put(e)
 

@@ -23,87 +23,62 @@ import numpy as np
 
 def data_sharding(mesh, *, axis: str = "data", rank: int = 2):
     """NamedSharding that splits the leading (batch) dim over ``axis``."""
-    import jax
     from jax.sharding import NamedSharding, PartitionSpec
 
     return NamedSharding(mesh, PartitionSpec(axis, *([None] * (rank - 1))))
 
 
-def device_put_batch(batch, mesh, axis: str = "data"):
+# one partitioner per (mesh, axis, mode): resolved placement flags and metric
+# handles live on it, and the per-segment hot path must not rebuild them.
+# Bounded: estimators build a FRESH mesh per fit by default, so an unbounded
+# id(mesh)-keyed dict would pin one mesh (and its device array) per fit for
+# the life of the driver; insertion-order eviction keeps the live fits' few
+# entries hot and frees retired meshes.
+_partitioner_cache: dict = {}
+_PARTITIONER_CACHE_MAX = 8
+
+
+def partitioner_for(mesh, axis: str = "data", shard_direct: bool = True):
+    """The shared ``DataParallelPartitioner`` for ``mesh`` — every feed
+    helper in this module routes through it, so batch-placement rules have
+    exactly one implementation (raydp_tpu/parallel/partitioner.py)."""
+    from raydp_tpu.parallel.partitioner import DataParallelPartitioner
+
+    key = (id(mesh), axis, bool(shard_direct))
+    part = _partitioner_cache.get(key)
+    if part is None or part.mesh is not mesh:
+        part = DataParallelPartitioner(mesh, axis, shard_direct=shard_direct)
+        while len(_partitioner_cache) >= _PARTITIONER_CACHE_MAX:
+            _partitioner_cache.pop(next(iter(_partitioner_cache)))
+        _partitioner_cache[key] = part
+    return part
+
+
+def device_put_batch(batch, mesh, axis: str = "data", shard_direct: bool = True):
     """Place a host batch (array or tuple of arrays) onto the mesh, sharded
-    over the batch dimension. In multi-process mode each process contributes
-    its local rows (``make_array_from_process_local_data``); single-process
-    this is a plain sharded device_put.
+    over the batch dimension — ``Partitioner.shard_inputs``. Shard-direct
+    (default) each process contributes only its local rows
+    (``make_array_from_process_local_data``); ``shard_direct=False`` is the
+    legacy driver-staged sharded ``device_put`` (the A/B arm).
 
     Single-device meshes skip the committed sharding entirely: an explicitly
     sharded input is semantically identical there but forces the SPMD-executor
     path, which on some PJRT plugins costs ~10ms per call (measured 30× on a
     tiny-step benchmark)."""
-    import jax
-
-    single_device = _mesh_device_count(mesh) <= 1 and jax.process_count() == 1
-
-    def _put(x):
-        if x is None:
-            return None
-        x = np.asarray(x)
-        if single_device:
-            import jax.numpy as jnp
-
-            device = _mesh_single_device(mesh)
-            if device == jax.devices()[0]:
-                # default device: stay uncommitted — committed arrays (even
-                # SingleDeviceSharding) force a ~10ms/call executor path on
-                # some PJRT plugins (14× step slowdown measured)
-                return jnp.asarray(x)
-            return jax.device_put(x, device)  # explicit non-default pin
-        sharding = data_sharding(mesh, axis=axis, rank=max(1, x.ndim))
-        if jax.process_count() > 1:
-            return jax.make_array_from_process_local_data(sharding, x)
-        return jax.device_put(x, sharding)
-
-    if isinstance(batch, (tuple, list)):
-        # recurse: a batch element may itself be a tuple of arrays (the
-        # mixed-dtype (dense, ids) feature container)
-        return type(batch)(device_put_batch(x, mesh, axis) for x in batch)
-    return _put(batch)
+    return partitioner_for(mesh, axis, shard_direct).shard_inputs(batch)
 
 
-def device_put_stacked(arr, mesh, axis: str = "data"):
+def device_put_stacked(arr, mesh, axis: str = "data", shard_direct: bool = True):
     """Place a STACKED [S, B, ...] host batch (leading scan dim unsharded,
     second dim sharded over ``axis``) onto the mesh — the upload recipe for
-    lax.scan-driven training segments. Shares device_put_batch's placement
-    rules: single-device default placement stays UNCOMMITTED (committed
-    arrays force a ~10ms/call executor path on some PJRT plugins);
-    multi-process assembles the global array from per-process rows."""
-    import jax
-
-    if jax.process_count() == 1 and _mesh_device_count(mesh) <= 1:
-        import jax.numpy as jnp
-
-        device = _mesh_single_device(mesh)
-        if device == jax.devices()[0]:
-            return jnp.asarray(arr)
-        return jax.device_put(arr, device)
-    from jax.sharding import NamedSharding, PartitionSpec
-
-    sharding = NamedSharding(
-        mesh, PartitionSpec(None, axis, *([None] * (arr.ndim - 2)))
-    )
-    if jax.process_count() > 1:
-        return jax.make_array_from_process_local_data(sharding, arr)
-    return jax.device_put(arr, sharding)
+    lax.scan-driven training segments (``Partitioner.shard_stacked``)."""
+    return partitioner_for(mesh, axis, shard_direct).shard_stacked(arr)
 
 
-def _mesh_device_count(mesh) -> int:
-    try:
-        return int(np.prod(list(mesh.shape.values())))
-    except Exception:
-        return 2  # unknown mesh type: assume multi-device
-
-
-def _mesh_single_device(mesh):
-    return np.asarray(mesh.devices).reshape(-1)[0]
+from raydp_tpu.parallel.partitioner import (  # noqa: E402 - shared helpers
+    _mesh_device_count,
+    _mesh_single_device,
+)
 
 
 class PrefetchingDeviceIterator:
@@ -116,7 +91,7 @@ class PrefetchingDeviceIterator:
     """
 
     def __init__(self, host_iter: Iterator, mesh, axis: str = "data",
-                 depth: int = 1):
+                 depth: int = 1, shard_direct: bool = True):
         from collections import deque
 
         from raydp_tpu.obs import metrics
@@ -124,6 +99,7 @@ class PrefetchingDeviceIterator:
         self._host_iter = iter(host_iter)
         self._mesh = mesh
         self._axis = axis
+        self._shard_direct = bool(shard_direct)
         self._depth = max(1, int(depth))
         self._pending = deque()
         self._exhausted = False
@@ -139,7 +115,10 @@ class PrefetchingDeviceIterator:
                 self._exhausted = True
                 return
             self._pending.append(
-                device_put_batch(batch, self._mesh, self._axis)
+                device_put_batch(
+                    batch, self._mesh, self._axis,
+                    shard_direct=self._shard_direct,
+                )
             )
 
     def __iter__(self):
@@ -207,28 +186,36 @@ def iter_prefetch(it: Iterator, depth: int = 1) -> Iterator:
 
 
 class SegmentUploader:
-    """Double-buffered streaming H2D: ``depth`` (default 2) reusable host
-    staging buffers feed ``device_put_stacked``. ``upload(hx, hy)`` copies
-    the segment into the least-recently-used buffer, starts the async
+    """N-way ping-pong streaming H2D: ``depth`` (default 2) reusable host
+    staging buffers feed ``Partitioner.shard_stacked``. ``upload(hx, hy)``
+    copies the segment into the least-recently-used buffer, starts the async
     transfer, and returns the device arrays; a buffer is recycled only
     after the transfer that last used it COMPLETED (``block_until_ready``
-    on the arrays from ``depth`` uploads ago — classic ping-pong). Stable
-    staging buffers mean the transport sees the same host pages every
-    segment instead of a fresh allocation per segment.
+    on the arrays from ``depth`` uploads ago — classic ping-pong,
+    generalized to ``depth`` rotating streams so ``depth - 1`` transfers
+    can be in flight while one buffer restages). Stable staging buffers
+    mean the transport sees the same host pages every segment instead of a
+    fresh allocation per segment.
 
     On backends where ``device_put``/``jnp.asarray`` may zero-copy ALIAS
     host numpy memory (CPU jax — the hazard class behind the PR 2 resume
     fix), buffer reuse is DISABLED automatically: the device array would
-    alias a buffer about to be overwritten two segments later. The
+    alias a buffer about to be overwritten ``depth`` segments later. The
     pipeline still overlaps decode with upload; it just allocates per
     segment there."""
 
     def __init__(self, mesh, axis: str = "data", depth: int = 2,
-                 reuse_host_buffers: Optional[bool] = None):
+                 reuse_host_buffers: Optional[bool] = None,
+                 partitioner=None):
         import jax
 
         self._mesh = mesh
         self._axis = axis
+        self._partitioner = (
+            partitioner
+            if partitioner is not None
+            else partitioner_for(mesh, axis)
+        )
         self._depth = max(2, int(depth))
         if reuse_host_buffers is None:
             reuse_host_buffers = jax.default_backend() != "cpu"
@@ -237,6 +224,13 @@ class SegmentUploader:
         self._pending: list = [None] * self._depth
         self._next = 0
         self.staging_copies = 0
+
+    @property
+    def upload_streams(self) -> int:
+        """How many rotating host staging streams this uploader ping-pongs
+        over (the ``stream_prefetch_segments`` depth when built by the
+        estimator)."""
+        return self._depth
 
     @staticmethod
     def _leaves(hx, hy):
@@ -321,20 +315,66 @@ class SegmentUploader:
             staged_x, staged_y = hx, hy
         dx = (
             type(hx)(
-                device_put_stacked(a, self._mesh, self._axis)
-                for a in staged_x
+                self._partitioner.shard_stacked(a) for a in staged_x
             )
             if isinstance(hx, (tuple, list))
-            else device_put_stacked(staged_x, self._mesh, self._axis)
+            else self._partitioner.shard_stacked(staged_x)
         )
         dy = (
-            device_put_stacked(staged_y, self._mesh, self._axis)
+            self._partitioner.shard_stacked(staged_y)
             if staged_y is not None
             else None
         )
         if self.reuse_host_buffers:
             self._pending[slot] = (dx, dy)
         return dx, dy
+
+
+# ---------------------------------------------------------------------------
+# mixed-dtype wire staging (the on-wire format of streaming segments)
+# ---------------------------------------------------------------------------
+#
+# Integer id columns already ride the wire exactly (int32 via feature_groups —
+# exact at ANY vocab size, where a float32 matrix silently collapses ids past
+# 2^24). The quantized-dense half: float feature leaves are staged int8 with a
+# PER-ROW scale and widened back to float ON CHIP inside the jitted scan —
+# ~3.2x fewer H2D bytes per dense leaf (1 byte/value + 4 bytes/row vs 4
+# bytes/value). Per-row (not per-segment) scales keep the format correct
+# under multi-process sharding: each row's scale travels WITH the row, so
+# shard-direct assembly never mixes scales computed from different processes.
+
+WIRE_SCALE_SUFFIX_NDIM = 1  # scales are [..., 1]: broadcast over features
+
+
+def quantize_rows(a: np.ndarray, dtype=np.int8):
+    """Symmetric per-row int8 quantization of a float array [..., F]:
+    returns ``(q, scale)`` with ``q = round(a / scale)`` clipped to ±127 and
+    ``scale = rowmax(|a|)/127`` shaped [..., 1] (float32). All-zero rows get
+    scale 1.0 so the round trip stays exact for them."""
+    a = np.asarray(a)
+    info = np.iinfo(dtype)
+    qmax = min(-info.min - 1, info.max)  # symmetric: ±127 for int8
+    amax = np.max(np.abs(a), axis=-1, keepdims=True)
+    scale = (amax / qmax).astype(np.float32)
+    scale[scale == 0] = 1.0
+    q = np.clip(np.rint(a / scale), -qmax, qmax).astype(dtype)
+    return q, scale
+
+
+def dequantize_rows(q, scale, dtype=np.float32):
+    """Host-side inverse of :func:`quantize_rows` — the reference the
+    on-chip widen must match bit-for-bit (both compute q·scale in float32)."""
+    return (np.asarray(q).astype(dtype) * np.asarray(scale)).astype(dtype)
+
+
+def widen_wire(q, scale, dtype=None):
+    """On-chip widen of a quantized leaf (jax ops — call INSIDE the jitted
+    scan): ``q.astype(f32) * scale``, broadcasting the [..., 1] row scales
+    over the feature dim. Bit-identical to :func:`dequantize_rows`."""
+    import jax.numpy as jnp
+
+    dtype = dtype or jnp.float32
+    return (q.astype(dtype) * scale).astype(dtype)
 
 
 def coalesce_segment(features, labels, batch_size: int):

@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raydp_tpu.parallel.mesh import axis_env_size
+
 
 def embedding_lookup_vocab_sharded(
     table: jnp.ndarray, ids: jnp.ndarray, axis_name: str
@@ -27,7 +29,7 @@ def embedding_lookup_vocab_sharded(
     """Per-device body (call inside shard_map): ``table`` is the local vocab
     shard [V/N, D]; ``ids`` are global ids (replicated). Each device gathers
     the ids that fall in its shard and a psum assembles full rows."""
-    n = lax.axis_size(axis_name)
+    n = axis_env_size(axis_name)
     my = lax.axis_index(axis_name)
     local_v = table.shape[0]
     start = my * local_v

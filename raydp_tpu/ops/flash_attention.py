@@ -121,7 +121,13 @@ def _flash_kernel(
 
 
 def _union_vma(*arrays):
-    vmas = [getattr(jax.typeof(a), "vma", None) for a in arrays]
+    # jax.typeof (and the vma tracking it exposes) only exists on modern jax;
+    # on older releases (0.4.x) there is no varying-manual-axes machinery to
+    # reconcile, so "no vma anywhere" is the correct answer — not a crash
+    typeof = getattr(jax, "typeof", None)
+    if typeof is None:
+        return None
+    vmas = [getattr(typeof(a), "vma", None) for a in arrays]
     if any(v is not None for v in vmas):
         return frozenset().union(*[v for v in vmas if v is not None])
     return None

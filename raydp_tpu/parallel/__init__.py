@@ -1,10 +1,16 @@
 """Parallelism: mesh construction, sharding rules, sequence parallelism."""
 
 from raydp_tpu.parallel.mesh import (
+    axis_env_size,
     data_parallel_mesh,
     make_mesh,
     mesh_axis_size,
     multihost_mesh,
+)
+from raydp_tpu.parallel.partitioner import (
+    DataParallelPartitioner,
+    NullPartitioner,
+    Partitioner,
 )
 from raydp_tpu.parallel.ring_attention import (
     full_attention,
@@ -17,6 +23,10 @@ from raydp_tpu.parallel.pipeline import pipeline_apply, pipeline_sharded
 from raydp_tpu.parallel.sharding import shard_params_by_rules, sharding_rules_fn
 
 __all__ = [
+    "DataParallelPartitioner",
+    "NullPartitioner",
+    "Partitioner",
+    "axis_env_size",
     "moe_apply",
     "moe_sharded",
     "pipeline_apply",

@@ -32,6 +32,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raydp_tpu.parallel.mesh import axis_env_size
+
 
 def moe_apply(
     expert_fn: Callable,
@@ -51,7 +53,7 @@ def moe_apply(
     """
     import math
 
-    n = lax.axis_size(axis_name)
+    n = axis_env_size(axis_name)
     b, d = x.shape
     k = min(top_k, n)
     # ceil keeps the requested headroom even at small per-device batches;

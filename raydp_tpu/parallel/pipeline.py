@@ -20,6 +20,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raydp_tpu.parallel.mesh import axis_env_size
+
 
 def pipeline_apply(
     stage_fn: Callable,
@@ -36,7 +38,7 @@ def pipeline_apply(
     Returns [M, B, F_out] (meaningful on the last device; replicate or
     psum-select outside as needed — see ``pipeline_sharded`` below).
     """
-    n = lax.axis_size(axis_name)
+    n = axis_env_size(axis_name)
     my = lax.axis_index(axis_name)
     m = microbatches.shape[0]
     ticks = m + n - 1
@@ -118,7 +120,7 @@ def pipeline_sharded(
         outs = pipeline_apply(stage_fn, params, micro_all, axis_name=axis)
         # broadcast the last stage's banked outputs to every device so the
         # out_spec can be replicated
-        n = lax.axis_size(axis)
+        n = axis_env_size(axis)
         mask = (lax.axis_index(axis) == n - 1).astype(outs.dtype)
         return lax.psum(outs * mask, axis)
 

@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from raydp_tpu.parallel.mesh import axis_env_size
+
 NEG_INF = -1e30
 
 
@@ -61,7 +63,7 @@ def _merge(o1, m1, l1, o2, m2, l2):
 
 def _ring_forward_stats(q, k, v, axis_name, causal, use_flash):
     """Ring forward returning (o_unnormalized, m, l)."""
-    n = lax.axis_size(axis_name)
+    n = axis_env_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     b, h, t, d = q.shape
     tk = k.shape[2]
@@ -171,7 +173,7 @@ def _ring_fwd(q, k, v, axis_name, causal, use_flash):
 
 def _ring_bwd(axis_name, causal, use_flash, residuals, g):
     q, k, v, out, lse = residuals
-    n = lax.axis_size(axis_name)
+    n = axis_env_size(axis_name)
     my_idx = lax.axis_index(axis_name)
     t, tk = q.shape[2], k.shape[2]
     perm = [(i, (i + 1) % n) for i in range(n)]
@@ -253,7 +255,7 @@ def ulysses_attention(
     budget; call inside shard_map. Per-device shapes: [B, H, T_local, D].
     ``use_flash``: compute the local attention with the fused pallas flash
     kernel (O(T) memory for the gathered sequence) instead of the einsum."""
-    n = lax.axis_size(axis_name)
+    n = axis_env_size(axis_name)
     b, h, t, d = q.shape
     if h % n:
         raise ValueError(f"heads {h} not divisible by sequence axis {n}")

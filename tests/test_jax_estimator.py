@@ -190,8 +190,13 @@ def test_dlrm_rejects_lossy_float_ids():
         jax.eval_shape(lambda a: model.init(jax.random.PRNGKey(0), a), x)
 
     # float64 carries ids up to 2^53 — accepted (needs x64 enabled, else
-    # JAX silently downcasts the input to float32 and the guard fires)
-    with jax.enable_x64(True):
+    # JAX silently downcasts the input to float32 and the guard fires).
+    # jax.enable_x64 is the modern spelling; 0.4.x only has the
+    # experimental entry point
+    enable_x64 = getattr(jax, "enable_x64", None)
+    if enable_x64 is None:
+        from jax.experimental import enable_x64
+    with enable_x64(True):
         ok = DLRM(vocab_sizes=[2**24 + 2], num_dense=2, embed_dim=4)
         x64 = np.zeros((4, 3), dtype=np.float64)
         jax.eval_shape(lambda a: ok.init(jax.random.PRNGKey(0), a), x64)

@@ -540,11 +540,7 @@ def test_flash_attention_composes_with_shard_map(cpu_mesh_devices):
     from raydp_tpu.ops import flash_attention
     from raydp_tpu.ops.flash_attention import _reference
     from raydp_tpu.parallel import make_mesh
-
-    try:
-        from jax import shard_map
-    except ImportError:
-        from jax.experimental.shard_map import shard_map
+    from raydp_tpu.parallel.sharding import shard_map_compat
 
     mesh = make_mesh({"data": 4}, jax.devices()[:4])
     rng = np.random.default_rng(13)
@@ -554,10 +550,11 @@ def test_flash_attention_composes_with_shard_map(cpu_mesh_devices):
     )
     spec = P("data", None, None, None)  # batch-sharded; attention is local
     # check_vma=False: the pallas interpreter can't reconcile invariant grid
-    # slices with varying operands (JAX's documented workaround)
-    out = shard_map(
+    # slices with varying operands (JAX's documented workaround);
+    # shard_map_compat translates it to check_rep on pre-typeof jax
+    out = shard_map_compat(
         lambda q_, k_, v_: flash_attention(q_, k_, v_, True, 32, 32),
-        mesh=mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
+        mesh, in_specs=(spec,) * 3, out_specs=spec, check_vma=False,
     )(q, k, v)
     ref = _reference(q, k, v, True)
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5)

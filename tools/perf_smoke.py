@@ -6,7 +6,7 @@ BENCH_* env vars), writes an artifact JSON holding the headline ETL numbers
 plus the full ``etl_breakdown`` and per-exchange shuffle stats, and FAILS
 when:
 
-- ``etl_query_s`` regresses more than 25% over the committed BENCH_r06
+- ``etl_query_s`` regresses more than 25% over the committed BENCH_r07
   snapshot's value (the CI slice runs ~10x fewer rows than the snapshot's
   run, so this is a smoke gate for gross regressions — a structural
   slowdown in the data plane, not a ±10% noise detector);
@@ -16,7 +16,19 @@ when:
 - the burst's repeated-query slice shows NO plan-cache hits (hit-rate must
   be > 0: identical query shapes re-executed must not replan);
 - an indexed shuffle writes more blocks than map tasks (the M-not-M×R
-  invariant of the pipelined shuffle data plane).
+  invariant of the pipelined shuffle data plane);
+- the uncached streaming fit's ``consumer_idle_s`` exceeds 0.2s — the
+  device-speed-ingest gate: the whole-fit producer + N-way upload streams
+  must keep the consumer thread fed (a per-epoch pipeline restart or a
+  decode moved back onto the consumer thread shows up here first);
+- the hybrid/streaming quotient (``streaming_hybrid_vs_scan`` over
+  ``streaming_vs_scan``, both interleaved medians since r07) falls more
+  than 25% below the snapshot's quotient. The quotient — not the raw
+  hybrid ratio — is what transfers across scales: the CI slice's tiny
+  fits are dispatch/compile-dominated, which deflates BOTH ratios
+  against the snapshot's 10x-bigger run, while "hybrid regressed below
+  the uncached path" (the r06 symptom this gate exists for) shows up in
+  the quotient at any scale.
 
 Usage: ``python tools/perf_smoke.py [artifact.json]``
 """
@@ -31,11 +43,12 @@ import subprocess
 import sys
 
 REGRESSION_BUDGET = 0.25  # fail above snapshot * (1 + budget)
+CONSUMER_IDLE_BUDGET_S = 0.2  # absolute: the streaming consumer stays fed
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-SNAPSHOT = "BENCH_r06.json"
+SNAPSHOT = "BENCH_r07.json"
 
 
 def _snapshot_value(key: str) -> float | None:
@@ -88,11 +101,20 @@ def main() -> int:
         "pandas_etl_s": detail["pandas_etl_s"],
         "cluster_boot_s": detail["cluster_boot_s"],
         "streaming_vs_scan": detail["streaming_vs_scan"],
+        "streaming_hybrid_vs_scan": detail.get("streaming_hybrid_vs_scan"),
         "streaming_pipeline": detail.get("streaming_pipeline", {}),
+        "streaming_hybrid_pipeline": detail.get(
+            "streaming_hybrid_pipeline", {}
+        ),
+        "streaming_ingest_probe": detail.get("streaming_ingest_probe", {}),
         "etl_breakdown": detail.get("etl_breakdown", {}),
         "shuffle_probe": detail.get("shuffle_probe", {}),
         "reference_etl_query_s": reference,
         "reference_burst_p50_ms": _snapshot_value("burst_p50_ms"),
+        "reference_streaming_vs_scan": _snapshot_value("streaming_vs_scan"),
+        "reference_streaming_hybrid_vs_scan": _snapshot_value(
+            "streaming_hybrid_vs_scan"
+        ),
         "regression_budget": REGRESSION_BUDGET,
         "rows": detail.get("rows"),
     }
@@ -124,6 +146,30 @@ def main() -> int:
             "plan-cache hit-rate is 0 on the repeated-query burst slice "
             "(identical query shapes re-executed must not replan)"
         )
+    consumer_idle = artifact["streaming_pipeline"].get("consumer_idle_s")
+    if consumer_idle is not None and consumer_idle > CONSUMER_IDLE_BUDGET_S:
+        failures.append(
+            f"streaming consumer_idle_s {consumer_idle:.3f}s exceeds the "
+            f"{CONSUMER_IDLE_BUDGET_S:.1f}s budget (uncached streaming must "
+            "keep the consumer thread fed — whole-fit producer / N-way "
+            "upload streams / off-thread decode)"
+        )
+    hybrid_ref = artifact["reference_streaming_hybrid_vs_scan"]
+    streaming_ref = artifact["reference_streaming_vs_scan"]
+    hybrid_ratio = artifact["streaming_hybrid_vs_scan"]
+    streaming_ratio = artifact["streaming_vs_scan"]
+    if None not in (hybrid_ref, streaming_ref, hybrid_ratio, streaming_ratio) \
+            and streaming_ref > 0 and streaming_ratio > 0:
+        quotient = hybrid_ratio / streaming_ratio
+        quotient_ref = hybrid_ref / streaming_ref
+        floor = quotient_ref * (1.0 - REGRESSION_BUDGET)
+        if quotient < floor:
+            failures.append(
+                f"hybrid/streaming quotient {quotient:.4f} below "
+                f"{floor:.4f} (snapshot {quotient_ref:.4f} - "
+                f"{REGRESSION_BUDGET:.0%}: hybrid regressed vs the "
+                "uncached path)"
+            )
     for entry in artifact["shuffle_probe"].get("shuffle", []):
         if entry.get("indexed") and entry["blocks"] > entry["map_tasks"]:
             failures.append(
