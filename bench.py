@@ -135,8 +135,16 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     t_i = time.perf_counter()
     ingest_probe = streaming_ingest_probe(ds, batch)
     t_ingest = time.perf_counter() - t_i
+    # recovery probe (separately timed, EXCLUDED from etl_query_s): the
+    # same data queried with one injected executor SIGKILL — lineage
+    # recovery's wall-clock cost as a first-class bench number
+    t_r = time.perf_counter()
+    rec_probe = recovery_probe(session, df)
+    t_recovery = time.perf_counter() - t_r
     raydp_tpu.stop_etl(cleanup_data=False, del_obj_holder=False)
-    t_query = time.perf_counter() - t0 - t_shuffle - t_burst - t_ingest
+    t_query = (
+        time.perf_counter() - t0 - t_shuffle - t_burst - t_ingest - t_recovery
+    )
     t_etl = t_boot + t_query
 
     est = JaxEstimator(
@@ -174,6 +182,8 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     cmp["etl_breakdown"] = etl_breakdown
     cmp["shuffle_probe"] = shuffle_probe
     cmp["streaming_ingest_probe"] = ingest_probe
+    cmp["recovery_probe"] = rec_probe
+    cmp["recovery_overhead"] = rec_probe.get("recovery_overhead")
     cmp.update(burst)
     cmp.update(
         fair_e2e_fields(pandas_taxi_etl, pdf, trained, t_boot, t_query, cmp)
@@ -218,6 +228,70 @@ def streaming_ingest_probe(ds, batch: int) -> dict:
     # runs and to carry its stats on hosts with cores to spare.
     stats["note"] = "live-session probe incl. compile; 2-core boxes starve executor decode"
     return stats
+
+
+def recovery_probe(session, df) -> dict:
+    """``recovery_overhead``: the same query with ONE injected executor
+    SIGKILL (no restart — the head unregisters the victim's blocks, so the
+    loss is real) vs the clean run on the same data. Lineage recovery
+    (docs/fault_tolerance.md) re-executes just the lost producing tasks and
+    rebinds; the probe reports the wall-clock ratio, the re-execution count,
+    and correctness. Separately timed, EXCLUDED from etl_query_s."""
+    from raydp_tpu import obs
+    from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+    from raydp_tpu.store import object_store as store
+
+    from tools.chaos import block_owner_executor, kill_executor
+
+    pool = len(session.executors)
+    ds = dataframe_to_dataset(df.repartition(4))
+    q = dataset_to_dataframe(session, ds)
+    q.count()  # warm-up: compile + cache the plan (interactive_burst does
+    # the same) so clean_s and recovered_s compare warm-vs-warm — a cold
+    # clean run would fold the one-time compile into the denominator and
+    # understate recovery_overhead
+    t0 = time.perf_counter()
+    clean_rows = q.count()
+    clean_s = time.perf_counter() - t0
+    before = obs.metrics.counter("lineage.reexecuted_tasks").value
+    victim = block_owner_executor(session, ds)
+    if victim is None:
+        # nothing executor-owned to lose (stale pool / ownership race):
+        # report a failed probe instead of crashing the whole bench
+        store.delete(ds.blocks)
+        return {"ok": False, "note": "no executor-owned blocks to kill"}
+    kill_executor(session, handle=victim)
+    time.sleep(0.3)  # let the head's owner-death unregister land
+    recovered_rows = None
+    error = None
+    t0 = time.perf_counter()
+    try:
+        # a recovery regression must surface as recovery_probe.ok=false in
+        # the artifact (perf_smoke gates on it), NOT crash the whole bench
+        recovered_rows = q.count()
+    except Exception as exc:
+        error = repr(exc)[:300]
+    recovered_s = time.perf_counter() - t0
+    reexecuted = int(
+        obs.metrics.counter("lineage.reexecuted_tasks").value - before
+    )
+    session.request_total_executors(pool)  # restore for later probes
+    try:
+        store.delete(ds.blocks)
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (probe cleanup best-effort; blocks die with the session)
+        pass
+    out = {
+        "clean_s": round(clean_s, 4),
+        "recovered_s": round(recovered_s, 4),
+        "recovery_overhead": (
+            round(recovered_s / clean_s, 3) if clean_s > 0 else None
+        ),
+        "reexecuted_tasks": reexecuted,
+        "ok": bool(recovered_rows == clean_rows and reexecuted >= 1),
+    }
+    if error is not None:
+        out["error"] = error
+    return out
 
 
 def interactive_burst(session, df, n_queries: int) -> dict:

@@ -136,6 +136,17 @@ class Head:
         self.named: Dict[str, str] = {}  # name -> actor_id; guarded-by: self.lock|self.actor_state_cond
         self.pgs: Dict[str, _PlacementGroup] = {}  # guarded-by: self.lock|self.actor_state_cond
         self.objects: Dict[str, _ObjectMeta] = {}  # guarded-by: self.lock|self.actor_state_cond
+        # owner-death tombstones: object_id -> dead owner. When an owner
+        # dies, its metas are POPPED (proactive unregister — they used to
+        # linger as owner_died records until a reader tripped over them)
+        # and tombstoned so reads still raise OwnerDiedError (the parity
+        # semantics) instead of a clean not-found. Bounded FIFO; a lineage
+        # rebind or a delete clears the tombstone.
+        import collections as _tomb_collections
+
+        self.owner_tombstones: "_tomb_collections.OrderedDict" = (
+            _tomb_collections.OrderedDict()
+        )  # guarded-by: self.lock|self.actor_state_cond
         # staged chunks of in-flight proxied puts + per-object last-activity
         # stamps (the TTL sweep in monitor_loop GCs abandoned uploads)
         self._proxy_staging: Dict[str, Dict[int, bytes]] = {}  # guarded-by: self.lock|self.actor_state_cond
@@ -894,10 +905,7 @@ class Head:
         namespace is authoritative — a tcp client's blocks carry its
         namespace even though its "node" is the driver."""
         if meta.owner_died:
-            raise OwnerDiedError(
-                f"object {object_id}: owner died and the object was not "
-                "transferred before the owner exited"
-            )
+            self._raise_owner_died(object_id, meta.owner)
         node = self.nodes.get(meta.node_id)
         if node is not None and node.agent_addr is not None:
             fetch_addr = node.agent_addr
@@ -916,6 +924,9 @@ class Head:
         with self.lock:
             meta = self.objects.get(object_id)
             if meta is None:
+                owner = self.owner_tombstones.get(object_id)
+                if owner is not None:
+                    self._raise_owner_died(object_id, owner)
                 return None
             return self._meta_view(object_id, meta)
 
@@ -932,18 +943,27 @@ class Head:
                 )
         return True
 
+    def _batch_meta(self, oid: str, lease: bool):  # guarded-by: self.lock|self.actor_state_cond held
+        """One batch entry. Tombstones were already handled: both callers
+        pre-raise via _raise_tombstoned_batch (which names EVERY tombstoned
+        id of the batch), so an absent id here is a plain None."""
+        meta = self.objects.get(oid)
+        if meta is None:
+            return None
+        view = self._meta_view(oid, meta)
+        if lease:
+            view["lease_s"] = self.LOCATION_LEASE_S
+        return view
+
     def handle_object_lookup_batch(self, object_ids: List[str]):
         """Vectorized lookup: {object_id: meta-or-None} in one frame (the
         reduce side resolves every input slice's block with a single RPC).
-        An owner-died object raises, exactly like the single lookup."""
+        An owner-died object raises, exactly like the single lookup — with
+        EVERY tombstoned id of the batch named in the error."""
         with self.lock:
+            self._raise_tombstoned_batch(object_ids)
             return {
-                oid: (
-                    None
-                    if (meta := self.objects.get(oid)) is None
-                    else self._meta_view(oid, meta)
-                )
-                for oid in object_ids
+                oid: self._batch_meta(oid, lease=False) for oid in object_ids
             }
 
     # how long a client may act on a served location without re-asking: the
@@ -960,16 +980,9 @@ class Head:
         is authoritative). The miss path of the executors' peer-to-peer
         block resolution (store.lookup_many)."""
         with self.lock:
+            self._raise_tombstoned_batch(object_ids)
             return {
-                oid: (
-                    None
-                    if (meta := self.objects.get(oid)) is None
-                    else {
-                        **self._meta_view(oid, meta),
-                        "lease_s": self.LOCATION_LEASE_S,
-                    }
-                )
-                for oid in object_ids
+                oid: self._batch_meta(oid, lease=True) for oid in object_ids
             }
 
     def handle_object_locations(self, object_ids: List[str]):
@@ -1009,8 +1022,51 @@ class Head:
                 for object_id in object_ids
                 if (meta := self.objects.pop(object_id, None)) is not None
             ]
+            for object_id in object_ids:
+                # deleting a tombstoned id makes later reads a clean
+                # not-found (deliberate deletion), not OwnerDiedError
+                self.owner_tombstones.pop(object_id, None)
         self._unlink_objects(metas)
         return True
+
+    def handle_object_rebind(self, mapping: Dict[str, str]):
+        """Lineage-recovery rebind: re-register each freshly regenerated
+        block (``new_id``, just written + registered by a surviving
+        executor) under its ORIGINAL object id, clearing the owner-death
+        tombstone — in-flight readers holding the old refs re-resolve and
+        find live bytes. Returns how many ids were rebound; a missing
+        new-id entry (racing deletion) is skipped and reflected in the
+        count so the recovery driver can fail loudly instead of serving a
+        half-rebound exchange."""
+        rebound = 0
+        duplicates: List[_ObjectMeta] = []
+        with self.lock:
+            for old_id, new_id in mapping.items():
+                meta = self.objects.pop(new_id, None)
+                if meta is None:
+                    continue
+                live = self.objects.get(old_id)
+                if live is not None and not live.owner_died:
+                    # duplicate recovery: another recoverer already rebound
+                    # this id — the old ref is LIVE. Keep the winner's meta
+                    # and unlink THIS duplicate's freshly written segment
+                    # (overwriting would orphan one segment either way);
+                    # counted as rebound because the caller's goal — the
+                    # old id resolves to live bytes — holds.
+                    duplicates.append(meta)
+                    rebound += 1
+                    continue
+                meta.object_id = old_id
+                self.objects[old_id] = meta
+                self.owner_tombstones.pop(old_id, None)
+                rebound += 1
+        if duplicates:
+            # off-lock like every unlink path (agent RPCs can be slow)
+            self._unlink_objects(duplicates)
+        if rebound:
+            obs_metrics.counter("head.objects_rebound").inc(rebound)
+            obs_instant("lineage.rebound", blocks=rebound)
+        return rebound
 
     def _unlink_objects(self, metas: List["_ObjectMeta"], wait: bool = False) -> None:
         """Release segments, routing remote-node objects through their agent.
@@ -1069,13 +1125,62 @@ class Head:
 
         unlink_block(shm_name)
 
+    TOMBSTONE_CAP = 16384
+
+    def _tombstone(self, object_id: str, owner: str) -> None:  # guarded-by: self.lock|self.actor_state_cond held
+        self.owner_tombstones[object_id] = owner
+        self.owner_tombstones.move_to_end(object_id)
+        while len(self.owner_tombstones) > self.TOMBSTONE_CAP:
+            self.owner_tombstones.popitem(last=False)
+
+    def _raise_owner_died(self, object_id: str, owner: str) -> None:
+        """OwnerDiedError carrying structured fields: the client's lineage
+        recovery reads ``object_ids`` and its dead-owner fast path reads
+        ``owner`` (BaseException pickling preserves the instance dict)."""
+        err = OwnerDiedError(
+            f"object {object_id}: owner {owner!r} died and the object was "
+            "not transferred before the owner exited"
+        )
+        err.object_ids = [object_id]
+        err.owner = owner
+        raise err
+
+    def _raise_tombstoned_batch(self, object_ids: List[str]) -> None:  # guarded-by: self.lock|self.actor_state_cond held
+        """Raise for a batch naming EVERY tombstoned id in it, not just the
+        first: the client's lineage recovery re-executes the whole named set
+        in one round — one-id-at-a-time errors would burn one retry attempt
+        per lost block and exhaust the task ladder on wide losses."""
+        dead = {
+            oid: owner
+            for oid in object_ids
+            if oid not in self.objects
+            and (owner := self.owner_tombstones.get(oid)) is not None
+        }
+        if not dead:
+            return
+        err = OwnerDiedError(
+            f"object(s) {list(dead)[:3]}{'…' if len(dead) > 3 else ''}: "
+            f"owner(s) died and the objects were not transferred "
+            f"({len(dead)} of {len(object_ids)} requested)"
+        )
+        err.object_ids = list(dead)
+        err.owner = next(iter(dead.values()))
+        raise err
+
     def _on_owner_dead(self, owner: str) -> None:  # guarded-by: self.lock|self.actor_state_cond held
         dead = []
-        for meta in self.objects.values():
+        for meta in list(self.objects.values()):
             if meta.owner == owner and not meta.owner_died:
                 meta.owner_died = True
                 dead.append(meta)
+                # proactive unregister: pop the record NOW (an intentional
+                # kill_executors/stop used to leave owner-died metas in the
+                # table forever) and tombstone the id so reads keep raising
+                # OwnerDiedError until a lineage rebind revives it
+                del self.objects[meta.object_id]
+                self._tombstone(meta.object_id, owner)
         if dead:
+            obs_metrics.counter("head.objects_unregistered").inc(len(dead))
             # called under the lock (monitor/death paths): release segments
             # from a thread so a slow/dead agent can't stall the head
             threading.Thread(

@@ -20,6 +20,7 @@ import numpy as np
 import pyarrow as pa
 
 from raydp_tpu.cluster.common import ActorDiedError as _ActorDied
+from raydp_tpu.cluster.common import ClusterError as _ClusterError
 from raydp_tpu.etl import plan as lp
 from raydp_tpu.etl import tasks as T
 from raydp_tpu.store import object_store as store
@@ -80,6 +81,28 @@ class Planner:
         self.plan_cache = True
         self.compiled_dispatch = True
         self.head_bypass = True
+        # lineage-based recovery (docs/fault_tolerance.md): every registered
+        # block records a compact lineage entry; a read that surfaces a
+        # lost-block error re-executes just the producing tasks on surviving
+        # executors and REBINDS the regenerated blocks under the original
+        # ids. Bounded: at most recovery_budget producing-task groups per
+        # query and recovery_max_depth transitive input levels — a flapping
+        # cluster fails fast instead of looping.
+        self.lineage_recovery = True
+        self.recovery_budget = 64
+        self.recovery_max_depth = 3
+        from raydp_tpu.etl import lineage as _lineage
+
+        self.lineage = _lineage.LineageRegistry()
+        from raydp_tpu.sanitize import named_lock as _recovery_named_lock
+
+        # serializes whole recovery passes: two threads losing the same
+        # block (estimator feed + driver query) must not both re-execute
+        # its producing task — the loser's probe then finds the winner's
+        # rebind and does zero work. Held across the recovery's RPCs BY
+        # DESIGN (serializing recovery is the point; the lock is outermost
+        # and its holders take no other path back into it).
+        self._recovery_lock = _recovery_named_lock("planner.recovery")
         import collections
 
         from raydp_tpu.sanitize import named_lock as _named_lock
@@ -120,6 +143,9 @@ class Planner:
         state.pop("_plan_cache", None)
         state.pop("_plan_cache_lock", None)
         state.pop("_plans_shipped", None)
+        # lineage entries hold live specs/closures — process-private
+        state.pop("lineage", None)
+        state.pop("_recovery_lock", None)
         return state
 
     def __setstate__(self, state):
@@ -137,6 +163,13 @@ class Planner:
         self.__dict__.setdefault("plan_cache", True)
         self.__dict__.setdefault("compiled_dispatch", True)
         self.__dict__.setdefault("head_bypass", True)
+        self.__dict__.setdefault("lineage_recovery", True)
+        self.__dict__.setdefault("recovery_budget", 64)
+        self.__dict__.setdefault("recovery_max_depth", 3)
+        from raydp_tpu.etl import lineage as _lineage
+
+        self.lineage = _lineage.LineageRegistry()
+        self._recovery_lock = named_lock("planner.recovery")
         import collections
 
         self._plan_cache = collections.OrderedDict()  # raydp-lint: disable=guarded-by (unpickle re-init: the object is not yet shared with any thread)
@@ -276,10 +309,18 @@ class Planner:
             if not self.executors:
                 results = []
                 for i, s in enumerate(specs):
-                    result = T.run_task(s)
+                    try:
+                        result = T.run_task(s)
+                    except _ClusterError as exc:
+                        # local-mode lost-block read: recover via lineage
+                        # (one retry — the rebound metadata serves the rest)
+                        if not self._try_block_recovery(exc, specs=(s,)):
+                            raise
+                        result = T.run_task(s)
                     results.append(result)
                     if on_result is not None:
                         on_result(i, result)
+                self._record_lineage(specs, results)
                 return results
             prefs = self._preferred_executors(specs)
             # one-dispatch batch path: a stage wider than the pool's task
@@ -294,6 +335,7 @@ class Planner:
                     for i, spec in enumerate(specs)
                 ]
                 results = self._gather(futures, specs, on_result)
+            self._record_lineage(specs, results)
             return results
         finally:
             if hook is not None:
@@ -386,6 +428,23 @@ class Planner:
                 )
                 obs.metrics.counter("etl.task_retries").inc(len(group))
                 fallback.extend(group)
+            except _ClusterError as exc:
+                # a lost-block read inside the batch fails the whole reply:
+                # lineage-recover the named blocks, refresh every group
+                # member's pushed metas, and fall back to the per-task
+                # ladder (anything else propagates unchanged)
+                if not self._try_block_recovery(
+                    exc, specs=[specs[i] for i in group]
+                ):
+                    raise
+                from raydp_tpu import obs
+
+                obs.instant(
+                    "etl.batch_retry", tasks=len(group), attempt=1,
+                    recovered=True,
+                )
+                obs.metrics.counter("etl.task_retries").inc(len(group))
+                fallback.extend(group)
         if fallback:
             # per-task retry ladder over a DENSE spec list (_gather indexes
             # positionally), then scatter back to stage positions
@@ -429,6 +488,23 @@ class Planner:
                     obs.metrics.counter("etl.task_retries").inc()
                     retry.append((self._dispatch(spec, i, attempt + 1), spec, i))
                     continue
+                except _ClusterError as exc:
+                    # application-level lost-block error (OwnerDiedError /
+                    # not-found out of the task's reads): re-execute the
+                    # producing tasks via lineage, then retry THIS task
+                    # against the rebound blocks. Any other application
+                    # error propagates exactly as before.
+                    if attempt == self.MAX_TASK_RETRIES or not (
+                        self._try_block_recovery(exc, specs=(spec,))
+                    ):
+                        raise
+                    obs.instant(
+                        "etl.task_retry", task=i, attempt=attempt + 1,
+                        recovered=True,
+                    )
+                    obs.metrics.counter("etl.task_retries").inc()
+                    retry.append((self._dispatch(spec, i, attempt + 1), spec, i))
+                    continue
                 if on_result is not None:
                     on_result(i, results[i])
             if not retry:
@@ -465,6 +541,7 @@ class Planner:
                     future = self._dispatch(spec, i, 0)
                 triples.append((future, spec, i))
             results = self._gather(triples, specs)
+            self._record_lineage(specs, results)
             return results
         finally:
             if hook is not None:
@@ -487,6 +564,103 @@ class Planner:
             except (NameError, AttributeError):  # raydp-lint: disable=swallowed-exceptions (dispatch raised before results existed)
                 pass  # dispatch raised before results existed
             stage_span.__exit__(None, None, None)
+
+    # ------------------------------------------------------------------
+    # lineage recording + recovery (docs/fault_tolerance.md)
+    # ------------------------------------------------------------------
+
+    def _record_lineage(self, specs, results) -> None:
+        """Record each dispatched spec's produced blocks (one dict insert
+        per block — the ~free happy-path half of lineage recovery)."""
+        if not self.lineage_recovery:
+            return
+        reg = getattr(self, "lineage", None)
+        if reg is None:
+            return
+        for spec, res in zip(specs, results):
+            if res is not None:
+                reg.record_spec(spec, res)
+
+    def _charge_recovery(self, n: int) -> None:
+        """Debit ``n`` producing-task re-executions against the per-query
+        budget; a flapping cluster fails fast instead of looping."""
+        from raydp_tpu.etl.lineage import RecoveryError
+
+        spent = getattr(self._tls, "recovery_spent", 0) + n
+        if spent > self.recovery_budget:
+            raise RecoveryError(
+                f"per-query re-execution budget exhausted ({spent} > "
+                f"{self.recovery_budget} producing tasks) — refusing to "
+                "chase a flapping cluster"
+            )
+        self._tls.recovery_spent = spent
+
+    def _submit_recovery(self, spec: T.TaskSpec):
+        """Re-run ONE producing task. Rides submit() so the re-execution
+        gets the normal dispatch/failover surface and its fresh blocks are
+        lineage-recorded like any other task's."""
+        return self.submit([spec])[0]
+
+    def recover_blocks(self, refs) -> int:
+        """Public recovery entry (Dataset reads, estimator feeds): lineage-
+        re-execute the producing tasks of the given refs/ids and rebind the
+        regenerated blocks under the original ids. Out-of-query calls get a
+        fresh re-execution budget."""
+        from raydp_tpu.etl import lineage as L
+
+        ids = [getattr(r, "object_id", r) for r in refs]
+        if not getattr(self._tls, "query_active", False):
+            self._tls.recovery_spent = 0
+        self._tls.in_recovery = True
+        try:
+            with self._recovery_lock:
+                return L.recover_blocks(self, ids)
+        finally:
+            self._tls.in_recovery = False
+
+    def _try_block_recovery(self, exc: BaseException, specs=()) -> bool:
+        """Classify-and-recover for a task/dispatch failure: True when
+        ``exc`` named lost blocks and lineage restored them (the caller
+        re-dispatches after the pushed metas are refreshed); False when the
+        error is not a lost-block error, recovery is disabled/re-entered,
+        or recovery itself failed (the caller re-raises the original)."""
+        from raydp_tpu import obs
+        from raydp_tpu.etl import lineage as L
+
+        if not self.lineage_recovery or getattr(self, "lineage", None) is None:
+            return False
+        if getattr(self._tls, "in_recovery", False):
+            return False
+        if not L.is_lost_block_error(exc):
+            return False
+        ids = L.missing_ids(exc)
+        if not ids:
+            return False
+        if not getattr(self._tls, "query_active", False):
+            # outside the query wrapper (direct submit() callers) each
+            # incident gets a fresh budget — the per-QUERY budget must not
+            # accumulate across unrelated operations until it permanently
+            # disables recovery on this thread
+            self._tls.recovery_spent = 0
+        # widen to EVERY input the failing spec(s) read: a read fails one
+        # stale block at a time, and recovering one-per-retry-attempt would
+        # exhaust the task ladder on wide losses — recover_blocks probes
+        # the whole set and re-executes only what is actually lost
+        for spec in specs:
+            ids.extend(L.spec_input_ids(spec))
+        ids = list(dict.fromkeys(ids))
+        self._tls.in_recovery = True
+        try:
+            with self._recovery_lock:
+                L.recover_blocks(self, ids)
+        except _ClusterError:
+            obs.instant("lineage.recovery_failed", blocks=len(ids))
+            return False
+        finally:
+            self._tls.in_recovery = False
+        for spec in specs:
+            L.refresh_spec_metas(spec, ids)
+        return True
 
     # ------------------------------------------------------------------
     # schema inference (run the pipeline on empty tables, locally)
@@ -800,15 +974,18 @@ class Planner:
             return run()  # nested (e.g. sort materializing its child):
             # stages contribute to the enclosing query's stats
         self._tls.query_active = True
+        self._tls.recovery_spent = 0  # fresh per-query re-execution budget
         # per-query control-plane accounting: process-wide counter deltas
         # around the query (concurrent queries on one process interleave
         # their deltas — documented; the counters themselves stay exact)
         _PC = ("hits", "misses", "unsupported")
+        _RC = ("reexecuted_tasks", "recovered_blocks")
         before = {
             "head_rpcs": obs.metrics.counter("rpc.client.calls").value,
             "dispatches": obs.metrics.counter("etl.actor_dispatches").value,
             "bypass": obs.metrics.counter("rpc.head_bypass_hits").value,
             **{k: obs.metrics.counter(f"plan_cache.{k}").value for k in _PC},
+            **{k: obs.metrics.counter(f"lineage.{k}").value for k in _RC},
         }
         try:
             with obs.collect() as records, obs.span("etl.query") as query_span:
@@ -822,6 +999,13 @@ class Planner:
         plan_cache["hit"] = (
             plan_cache["hits"] > 0 and plan_cache["misses"] == 0
         )
+        recovery = {
+            # lineage activity this query paid for: re-executed producing
+            # tasks and blocks rebound under their original ids (both 0 on
+            # the happy path — the perf gate holds lineage ~free)
+            k: int(obs.metrics.counter(f"lineage.{k}").value - before[k])
+            for k in _RC
+        }
         rpc_stats = {
             # control-plane round trips this query cost: head/agent RPCs
             # (rpc.client.calls delta) and executor dispatches — the two
@@ -868,6 +1052,7 @@ class Planner:
             "shuffle": shuffle,
             "plan_cache": plan_cache,
             "rpc": rpc_stats,
+            "recovery": recovery,
         }
         return results
 
@@ -1186,6 +1371,12 @@ class Planner:
                     )
                 except (ConnectionError, EOFError, _ActorDied):
                     delivery_failed = True
+                except _ClusterError as exc:
+                    # lost-block read inside the fused exchange: recover,
+                    # refresh the map specs' pushed metas, re-run two-stage
+                    if not self._try_block_recovery(exc, specs=map_specs):
+                        raise
+                    delivery_failed = True
                 except AttributeError as exc:
                     # ONLY the missing-method signature of an older executor
                     # falls back; a genuine AttributeError inside a task
@@ -1230,6 +1421,21 @@ class Planner:
         blocks = [
             b for res in map_results for b in res.blocks if b is not None
         ]
+        # lineage: map specs were built driver-side; reduce specs are
+        # rebuilt on demand from the map results (deferred — no cost here)
+        self._record_lineage(map_specs, map_results)
+        if self.lineage_recovery and getattr(self, "lineage", None) is not None:
+            for r, res in enumerate(out):
+                def _make_reduce(
+                    r=r, spec_fn=spec_fn, map_results=map_results,
+                    schema_ipc=schema_ipc, num_reducers=num_reducers,
+                ):
+                    reads = T.build_shuffle_reads(
+                        map_results, num_reducers, schema_ipc
+                    )
+                    return spec_fn(r, reads[r])
+
+                self.lineage.record_maker(_make_reduce, res)
         obs.instant(
             "etl.shuffle",
             map_tasks=len(map_specs),
@@ -1934,6 +2140,19 @@ class Planner:
                 ).result()
         except (ConnectionError, EOFError, _ActorDied):
             return None
+        except _ClusterError as exc:
+            # a lost-block read inside the compiled program (head-bypass
+            # stale location / dead owner): lineage-recover, refresh the
+            # binding's pushed metas IN PLACE (the staged fallback reuses
+            # these ReadSpec objects), and fall back
+            if not self._try_block_recovery(exc):
+                raise
+            from raydp_tpu.etl import lineage as L
+
+            L.refresh_reads(
+                binding.get("reads") or [], L.missing_ids(exc)
+            )
+            return None
         except AttributeError as exc:
             # only the missing-method signature of an older executor falls
             # back; a genuine AttributeError in a task body must propagate
@@ -2074,6 +2293,21 @@ class Planner:
                     ]
                     for j, r in enumerate(self._gather(retry, dense)):
                         results[fallback[j]] = r
+                # lineage: one DEFERRED maker per partition — the concrete
+                # TaskSpec is only built if recovery ever needs it
+                if self.lineage_recovery and getattr(self, "lineage", None) is not None:
+                    for i2, read in enumerate(reads):
+                        def _make_simple(
+                            read=read, i2=i2, program=program, binding=binding
+                        ):
+                            from raydp_tpu.etl import program as _P
+
+                            return _P.build_simple_specs(
+                                program,
+                                {**binding, "reads": [read], "indices": [i2]},
+                            )[0]
+
+                        self.lineage.record_maker(_make_simple, results[i2])
                 stage_span.set(
                     dispatch="compiled",
                     locality_preferred=npref,
@@ -2176,6 +2410,27 @@ class Planner:
         )
         obs.metrics.counter("etl.fused_exchanges").inc()
         obs.metrics.counter("etl.compiled_dispatches").inc()
+        # lineage: deferred makers for both rounds (zero happy-path bind)
+        if self.lineage_recovery and getattr(self, "lineage", None) is not None:
+            for j, res in enumerate(map_results):
+                def _make_map(j=j, program=program, b=b):
+                    from raydp_tpu.etl import program as _P
+
+                    return _P.build_exchange_stages(program, b)[0][j]
+
+                self.lineage.record_maker(_make_map, res)
+            for r, res in enumerate(out):
+                def _make_red(r=r, program=program, b=b, map_results=map_results):
+                    from raydp_tpu.etl import program as _P
+
+                    _, reduce_spec = _P.build_exchange_stages(program, b)
+                    reads2 = T.build_shuffle_reads(
+                        map_results, program.num_reducers,
+                        program.child_schema_ipc,
+                    )
+                    return reduce_spec(r, reads2[r])
+
+                self.lineage.record_maker(_make_red, res)
         blocks = [
             blk for res in map_results for blk in res.blocks if blk is not None
         ]

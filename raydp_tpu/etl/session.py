@@ -232,6 +232,17 @@ class EtlSession:
         self._planner.plan_cache = _flag("planner.plan_cache")
         self._planner.compiled_dispatch = _flag("planner.compiled_dispatch")
         self._planner.head_bypass = _flag("planner.head_bypass")
+        # lineage-based recovery (docs/fault_tolerance.md): default ON —
+        # a lost block re-executes its producing task on surviving
+        # executors instead of failing the query; budget/depth bound a
+        # flapping cluster to a fast failure
+        self._planner.lineage_recovery = _flag("planner.lineage_recovery")
+        self._planner.recovery_budget = int(
+            self.configs.get("planner.recovery_budget", 64)
+        )
+        self._planner.recovery_max_depth = int(
+            self.configs.get("planner.recovery_max_depth", 3)
+        )
         from raydp_tpu.store import object_store as _store
 
         _store.set_location_cache(self._planner.head_bypass)
@@ -269,8 +280,27 @@ class EtlSession:
         self._dyn_idle_s = float(
             self.configs.get("etl.dynamicAllocation.idleTimeout", 10.0)
         )
+        #   etl.dynamicAllocation.sustainedStages (default 1): how many
+        #   CONSECUTIVE over-threshold stages must be observed before
+        #   scaling out — >1 makes scale-out react to sustained dispatch-
+        #   queue depth instead of a single wide stage (one burst should
+        #   not fork executors it will idle-kill ten seconds later)
+        self._dyn_sustained = max(
+            1, int(self.configs.get("etl.dynamicAllocation.sustainedStages", 1))
+        )
+        self._wide_streak = 0
         self._last_stage_ts = time.monotonic()
         self._dealloc_stop = threading.Event()
+        # touch the elasticity counters so they appear in dump_metrics()
+        # snapshots even before the first scale event (pinned-schema tests
+        # and dashboards rely on the keys existing)
+        from raydp_tpu import obs as _obs
+
+        _obs.metrics.counter("cluster.scale_out")
+        _obs.metrics.counter("cluster.scale_in")
+        _obs.metrics.counter("lineage.reexecuted_tasks")
+        _obs.metrics.counter("lineage.recovered_blocks")
+        _obs.metrics.counter("etl.task_retries")
         if self._dyn_enabled:
             self._planner.scale_hook = self._on_stage_width
             threading.Thread(
@@ -372,16 +402,23 @@ class EtlSession:
         """Scale-up half of dynamic allocation: called by the planner before
         dispatching a stage. A stage wider than tasksPerSlot × slots grows
         the pool (bounded by maxExecutors) IN TIME for this stage's dispatch
-        to round-robin onto the new executors."""
+        to round-robin onto the new executors. With ``sustainedStages`` > 1
+        the trigger is SUSTAINED dispatch-queue depth: only after that many
+        consecutive over-threshold stages does the pool grow."""
         self._last_stage_ts = time.monotonic()
         slots = max(1, int(self.executor_cores))
         desired = -(-num_tasks // (self._dyn_tasks_per_slot * slots))
         desired = min(self._dyn_max, max(desired, len(self.executors)))
         if desired > len(self.executors):
+            self._wide_streak += 1
+            if self._wide_streak < self._dyn_sustained:
+                return  # one wide stage is a burst, not sustained depth
             try:
                 self.request_total_executors(desired)
             except ClusterError:  # raydp-lint: disable=swallowed-exceptions (no capacity: the stage runs on the current pool)
                 pass  # no capacity: the stage runs on the current pool
+        else:
+            self._wide_streak = 0
 
     def _dealloc_loop(self) -> None:
         """Scale-down half: after idleTimeout with no stage activity (and no
@@ -408,9 +445,51 @@ class EtlSession:
 
                     metrics.counter("etl.dynamic_scale_failures").inc()
 
+    def prune_dead_executors(self) -> int:
+        """Drop DEAD handles from the pool. Executors killed out-of-band
+        (chaos SIGKILL, node loss, restarts exhausted) are skipped by the
+        dispatch ladder but still COUNT toward pool size — without the
+        prune, a scale-out "restoring" the pool after a loss would no-op
+        against the corpses. Returns how many handles were removed."""
+        from raydp_tpu.cluster.common import ActorState
+
+        dead_ids = set()
+        for handle in list(self.executors):
+            try:
+                if handle.state() == ActorState.DEAD:
+                    dead_ids.add(handle._actor_id)
+            except ClusterError as exc:
+                # ONLY a positive "actor unknown" counts as dead; a
+                # transient head stall must not evacuate a live pool (and
+                # poison the dead-owner registry for live owners) — the
+                # dispatch ladder skips dead executors anyway, so keeping
+                # a corpse one more round is the safe error
+                if "unknown" in str(exc):
+                    dead_ids.add(handle._actor_id)
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (transport hiccup probing liveness: keep the handle, the next prune re-checks)
+                pass
+        if not dead_ids:
+            return 0
+        # same lock discipline as kill_executors: the planner's executor
+        # list must never be observed mid-edit by a stage submission
+        planner = self._planner
+        with planner._inflight_lock:
+            self.executors = [
+                h for h in self.executors if h._actor_id not in dead_ids
+            ]
+            planner.executors = list(self.executors)
+        from raydp_tpu.store import object_store as _store
+
+        for actor_id in dead_ids:
+            _store.note_owner_dead(actor_id)
+        return len(dead_ids)
+
     def request_total_executors(self, total: int) -> int:
         """Scale the executor pool up to ``total`` (no-op when already at or
-        above). Returns the live executor count."""
+        above). Dead handles are pruned first, so "restore the pool to N"
+        after an executor loss really yields N LIVE executors. Returns the
+        live executor count."""
+        self.prune_dead_executors()
         actor_cpu = float(self.configs.get("etl.actor.resource.cpu", self.executor_cores))
         grow = total - len(self.executors)
         if grow > 0:
@@ -427,6 +506,8 @@ class EtlSession:
                         "memory": max(float(1 << 30), need_mem - free_mem),
                     }
                 )
+        added = 0
+        t0 = time.perf_counter()
         while len(self.executors) < total:
             i = self._next_executor_id
             self._next_executor_id += 1
@@ -444,7 +525,20 @@ class EtlSession:
                 env=getattr(self, "_executor_env", {}),
             )
             self.executors.append(handle)
+            added += 1
         self._planner.executors = list(self.executors)
+        if added:
+            from raydp_tpu import obs
+
+            # scale-out rides the zygote warm-fork spawn path — the elapsed
+            # time on the instant is the sub-second-scale-out evidence
+            obs.metrics.counter("cluster.scale_out").inc(added)
+            obs.instant(
+                "cluster.scale_out",
+                added=added,
+                pool=len(self.executors),
+                seconds=round(time.perf_counter() - t0, 4),
+            )
         return len(self.executors)
 
     def kill_executors(
@@ -479,14 +573,21 @@ class EtlSession:
             # (kill + DEAD-drain) window must not round-robin onto victims
             planner.executors = list(self.executors)
         for handle in victims:
+            # graceful scale-in re-replicates ownership BEFORE the kill: the
+            # departing executor's blocks move to the session master (their
+            # segments survive the process; only owner-death GC would unlink
+            # them). Blocks the reown misses — racing writes, an older
+            # head — stay covered by lineage recovery: their entries still
+            # name the producing tasks, so a later read re-executes instead
+            # of failing (docs/fault_tolerance.md "scale-in").
             try:
                 cluster.head_rpc(
                     "object_reown_all",
                     old_owner=handle._actor_id,
                     new_owner=self.master._actor_id,
                 )
-            except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death; reown is best-effort)
-                pass  # older head / racing shutdown: blocks fall back to GC
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death; reown is best-effort, lineage covers the rest)
+                pass  # older head / racing shutdown: lineage recovery covers
         for handle in victims:
             try:
                 handle.kill(no_restart=True)
@@ -502,6 +603,22 @@ class EtlSession:
                     break
                 time.sleep(0.05)
         self._planner.executors = list(self.executors)
+        if victims:
+            from raydp_tpu import obs
+            from raydp_tpu.store import object_store as _store
+
+            obs.metrics.counter("cluster.scale_in").inc(len(victims))
+            obs.instant(
+                "cluster.scale_in",
+                removed=len(victims),
+                pool=len(self.executors),
+            )
+            # the victims are dead for good: any block the reown missed is
+            # lost — feed the store's dead-owner registry so stale cached
+            # locations fast-path to OwnerDiedError (→ lineage recovery)
+            # instead of paying a head round trip to learn the same thing
+            for handle in victims:
+                _store.note_owner_dead(handle._actor_id)
         return len(self.executors)
 
     # ------------------------------------------------------------------
@@ -524,11 +641,19 @@ class EtlSession:
         # stale handles must not look like a live pool (Dataset._slice_block
         # and any late queries fall back to driver-local paths)
         self._planner.executors = []
+        from raydp_tpu.store import object_store as _store
+
         for handle in killed:
             try:
                 handle.kill(no_restart=True)
             except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death)
                 pass
+            # intentional kills are final: record the dead owners so stale
+            # head-bypass locations fast-path to OwnerDiedError instead of
+            # costing a head round trip per read (the head proactively
+            # unregisters their blocks at death — satellite of the lineage
+            # recovery plane)
+            _store.note_owner_dead(handle._actor_id)
         self.executors = []
         # drain: wait for the head to reap the executors so their resources
         # and names are free before a subsequent init_etl schedules

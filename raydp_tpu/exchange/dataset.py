@@ -75,19 +75,42 @@ class Dataset:
         )
 
     def get_block(self, index: int) -> pa.Table:
-        """Read one block (zero-copy); on owner-death, recover via lineage if
-        this dataset is recoverable."""
+        """Read one block (zero-copy). A lost block (owner died / deleted)
+        recovers through the planner's LINEAGE first — re-execute just the
+        producing task and rebind the regenerated block under the same ref
+        (docs/fault_tolerance.md) — and only falls back to the coarse
+        whole-plan re-materialization ``from_etl_recoverable`` datasets
+        carry. Recovery requires a LIVE session: after ``stop_etl`` the
+        ownership contract holds (non-transferred data is gone —
+        test_ownership_dies_with_session)."""
         try:
             return T.read_table_block(self.blocks[index])
-        except ClusterError:
-            if self._recover_plan is None or self._session is None:
-                raise
-            self._recover_all()
-            return T.read_table_block(self.blocks[index])
+        except ClusterError as exc:
+            return self._recover_block(index, exc)
+
+    def _recover_block(self, index: int, exc: ClusterError) -> pa.Table:
+        from raydp_tpu.etl import lineage as _lineage
+
+        session = self._session
+        live = session is not None and not getattr(session, "_stopped", True)
+        if live and _lineage.is_lost_block_error(exc):
+            planner = getattr(session, "_planner", None)
+            if planner is not None and planner.lineage_recovery:
+                try:
+                    planner.recover_blocks([self.blocks[index]])
+                    return T.read_table_block(self.blocks[index])
+                except ClusterError:  # raydp-lint: disable=swallowed-exceptions (no lineage entry / re-execution failed: fall through to plan re-materialization, original error re-raised below when absent)
+                    pass
+        if self._recover_plan is None or session is None:
+            raise exc
+        self._recover_all()
+        return T.read_table_block(self.blocks[index])
 
     def _recover_all(self) -> None:
         """Re-execute the producing plan and swap in fresh blocks (coarse
-        re-materialization — the analog of RecacheRDD re-running rdd.count)."""
+        re-materialization — the analog of RecacheRDD re-running rdd.count).
+        The deep fallback behind lineage recovery: it handles even total
+        loss of every block AND its lineage (e.g. a new driver process)."""
         mat = self._session._planner.materialize(self._recover_plan)
         self.blocks = [b for b in mat.blocks if b is not None]
         self.counts = [c for b, c in zip(mat.blocks, mat.counts) if b is not None]

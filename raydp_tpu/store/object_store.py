@@ -136,6 +136,69 @@ def evict_location(object_id: str) -> None:
         _location_cache.pop(object_id, None)
 
 
+# ---------------------------------------------------------------------------
+# dead-owner registry (head-bypass stale-location fast path)
+#
+# A cache-served read that finds its segment gone cannot tell "deleted /
+# moved" (retry through the head — it may have been lineage-rebound) from
+# "owner is dead" (the head would just raise OwnerDiedError). This process
+# REMEMBERS owners it has seen die — from head OwnerDiedError replies and
+# from the session's own intentional executor kills — so the stale-location
+# path raises OwnerDiedError immediately and lineage recovery triggers
+# without a wasted head round trip. Bounded; cleared with the cache toggle.
+# ---------------------------------------------------------------------------
+
+import collections as _collections  # noqa: E402
+
+_DEAD_OWNER_CAP = 1024
+_dead_owners: "_collections.OrderedDict" = _collections.OrderedDict()  # guarded-by: _location_lock
+
+
+def note_owner_dead(owner: Optional[str]) -> None:
+    """Record that ``owner``'s objects are gone for good (fed by head
+    OwnerDiedError replies and by intentional executor kills)."""
+    if not owner or owner == DRIVER_OWNER:
+        return
+    with _location_lock:
+        _dead_owners[owner] = True
+        _dead_owners.move_to_end(owner)
+        while len(_dead_owners) > _DEAD_OWNER_CAP:
+            _dead_owners.popitem(last=False)
+
+
+def owner_known_dead(owner: Optional[str]) -> bool:
+    if not owner:
+        return False
+    with _location_lock:
+        return owner in _dead_owners
+
+
+def _note_dead_owner_from(exc: BaseException) -> None:
+    note_owner_dead(getattr(exc, "owner", None))
+
+
+# ids THIS process deliberately deleted (bounded): lineage recovery refuses
+# to resurrect them at depth 0 — "deleted" must stay deleted. Keyed locally
+# (not by head tombstone absence) so a mass owner-death that overflows the
+# head's tombstone table can never be misread as deletion and refused.
+_RECENT_DELETE_CAP = 8192
+_recent_deletes: "_collections.OrderedDict" = _collections.OrderedDict()  # guarded-by: _location_lock
+
+
+def _note_deleted(object_ids) -> None:
+    with _location_lock:
+        for oid in object_ids:
+            _recent_deletes[oid] = True
+            _recent_deletes.move_to_end(oid)
+        while len(_recent_deletes) > _RECENT_DELETE_CAP:
+            _recent_deletes.popitem(last=False)
+
+
+def was_deleted_here(object_id: str) -> bool:
+    with _location_lock:
+        return object_id in _recent_deletes
+
+
 def seed_locations(entries: dict) -> None:
     """Adopt lease-stamped entries pushed with a task's ReadSpecs:
     ``{object_id: (meta, age_s)}`` where ``age_s`` is how old the entry
@@ -763,9 +826,17 @@ def _lookup(ref: ObjectRef, fresh: bool = False) -> dict:
 
             metrics.counter("rpc.head_bypass_hits").inc()
             return meta
-    meta = cluster_api.head_rpc("object_lookup", object_id=ref.object_id)
+    try:
+        meta = cluster_api.head_rpc("object_lookup", object_id=ref.object_id)
+    except OwnerDiedError as exc:
+        _note_dead_owner_from(exc)
+        raise
     if meta is None:
-        raise ClusterError(f"object {ref.object_id} not found (already deleted?)")
+        err = ClusterError(
+            f"object {ref.object_id} not found (already deleted?)"
+        )
+        err.object_ids = [ref.object_id]
+        raise err
     cache_location(ref.object_id, meta)
     return meta
 
@@ -776,6 +847,9 @@ def _lookup_batch_rpc(ids: List[str]) -> dict:
     lookup otherwise, per-ref lookups against the oldest heads."""
     try:
         metas = cluster_api.head_rpc("object_lookup_lease", object_ids=ids)
+    except OwnerDiedError as exc:
+        _note_dead_owner_from(exc)
+        raise
     except ClusterError as exc:
         if "unknown head method" not in str(exc):
             raise
@@ -820,9 +894,11 @@ def lookup_many(refs: Sequence[ObjectRef]) -> dict:
         metas.update(_lookup_batch_rpc(missing))
     absent = [oid for oid in ids if metas.get(oid) is None]
     if absent:
-        raise ClusterError(
+        err = ClusterError(
             f"object(s) {absent[:3]} not found (already deleted?)"
         )
+        err.object_ids = absent
+        raise err
     return metas
 
 
@@ -929,10 +1005,28 @@ def _retry_uncached(ref: ObjectRef, meta: Optional[dict], exc: BaseException):
     gone re-resolves through the head once — the head is authoritative for
     deletion and owner death, so the caller gets OwnerDiedError / a clean
     not-found instead of a stale-bypass artifact. Returns the fresh meta, or
-    re-raises ``exc`` when the location didn't come from the cache."""
+    re-raises ``exc`` when the location didn't come from the cache.
+
+    Fast path: when the stale entry's recorded owner is ALREADY known dead
+    in this process (head OwnerDiedError seen before / intentional executor
+    kill), raise OwnerDiedError immediately — lineage recovery is the only
+    way forward, and the head round trip would just say the same thing. A
+    block the recovery layer REBOUND carries the new (live) owner in its
+    refreshed records, so rebound reads never hit this path."""
     if meta is None or not meta.get("cached"):
         raise exc
     evict_location(ref.object_id)
+    if owner_known_dead(meta.get("owner")):
+        from raydp_tpu.obs import metrics
+
+        metrics.counter("store.dead_owner_fastpath").inc()
+        err = OwnerDiedError(
+            f"object {ref.object_id}: cached location's owner "
+            f"{meta.get('owner')!r} is known dead (head-bypass fast path)"
+        )
+        err.object_ids = [ref.object_id]
+        err.owner = meta.get("owner")
+        raise err from exc
     return _lookup(ref, fresh=True)
 
 
@@ -971,23 +1065,29 @@ def _get_buffer_resolved(ref: ObjectRef, meta: Optional[dict] = None):
         try:
             return _FileBuffer(path, meta["size"])
         except OSError as exc:
-            raise ClusterError(
+            err = ClusterError(
                 f"object {ref.object_id} metadata exists but spill file is "
                 f"gone ({exc})"
             )
+            err.object_ids = [ref.object_id]
+            raise err
     lib = _load_native()
     seg_size = ctypes.c_uint64()
     ptr = lib.rtpu_shm_map(meta["shm_name"].encode(), ctypes.byref(seg_size), 0)
     if not ptr:
-        raise ClusterError(
+        err = ClusterError(
             f"object {ref.object_id} metadata exists but segment is gone"
         )
+        err.object_ids = [ref.object_id]
+        raise err
     if seg_size.value < meta["size"]:
         lib.rtpu_shm_unmap(ctypes.c_void_p(ptr), seg_size.value)
-        raise ClusterError(
+        err = ClusterError(
             f"object {ref.object_id} segment truncated: "
             f"{seg_size.value} < {meta['size']}"
         )
+        err.object_ids = [ref.object_id]
+        raise err
     return _MappedBuffer(lib, ptr, meta["size"], mapped_size=seg_size.value)
 
 
@@ -1076,6 +1176,7 @@ def transfer(refs: Sequence[ObjectRef], new_owner: str) -> None:
 def delete(refs: Sequence[ObjectRef]) -> None:
     for r in refs:
         evict_location(r.object_id)
+    _note_deleted([r.object_id for r in refs])
     cluster_api.head_rpc("object_delete", object_ids=[r.object_id for r in refs])
 
 

@@ -1,0 +1,307 @@
+"""Chaos-mode recovery tests: SIGKILL executors holding live blocks and
+assert queries/fits come back byte-identical through lineage recovery
+(docs/fault_tolerance.md), with the suite-wide sanitizers armed as the
+recovery-correctness oracle.
+
+The scenario bodies live in tools/chaos.py (the same code the CI
+``chaos-smoke`` job runs); here they run as tier-1 tests plus white-box
+cases the CLI can't express: a deterministic kill BETWEEN a shuffle's map
+and reduce rounds, the dead-owner fast path's zero-head-RPC contract, and
+the re-execution budget's fail-fast."""
+
+import time
+
+import pytest
+
+import raydp_tpu
+from raydp_tpu.cluster.common import ClusterError, OwnerDiedError
+from raydp_tpu.etl import functions as F
+from raydp_tpu.etl import tasks as T
+from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+from raydp_tpu.store import object_store as store
+from tools import chaos
+
+
+@pytest.fixture()
+def session():
+    s = raydp_tpu.init_etl(
+        "test-chaos", num_executors=2, executor_cores=1,
+        executor_memory="300M",
+    )
+    yield s
+    raydp_tpu.stop_etl()
+
+
+def _reexecuted() -> int:
+    return chaos.lineage_counters()["reexecuted_tasks"]
+
+
+# ---------------------------------------------------------------------------
+# harness scenarios as tier-1 tests (the CI chaos-smoke slice)
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_mid_shuffle_kill_byte_identical():
+    report = chaos.scenario_mid_shuffle(rows=60_000)
+    assert report["byte_identical"], report
+    assert report["reexecuted_tasks"] >= 1, report
+    assert report["within_bound"], report
+
+
+def test_chaos_mid_compiled_dispatch_kill():
+    report = chaos.scenario_mid_compiled(rows=20_000)
+    assert report["ok"], report
+
+
+def test_chaos_mid_streaming_fit_kill_byte_identical():
+    report = chaos.scenario_mid_fit(rows=1536)
+    assert report["byte_identical"], report
+    assert report["reexecuted_tasks"] >= 1, report
+
+
+# ---------------------------------------------------------------------------
+# white-box: deterministic kill BETWEEN map and reduce rounds
+# ---------------------------------------------------------------------------
+
+
+def test_kill_between_map_and_reduce_recovers(session):
+    """The gap the task-retry ladder can't cover: the map round RETURNED,
+    then its outputs vanish before the reduce reads them. The reduce read
+    surfaces OwnerDiedError; lineage re-executes just the lost map tasks
+    (transitively re-materializing their inputs) on the survivor."""
+    planner = session._planner
+    # 6 partitions over 2 executors: the victim owns THREE of one reduce
+    # task's inputs — wider than the task-retry ladder (2 retries), so
+    # recovery must restore the whole missing set in ONE round (the review
+    # finding: one-id-per-round recovery exhausted the ladder at 3+ losses)
+    df = session.range(30_000, num_partitions=6).with_column(
+        "k", F.col("id") % 7
+    )
+    mat = df.materialize()
+    schema_ipc = T.schema_ipc_bytes(mat.schema)
+    map_out = planner._split_output("hash_split", num_splits=3, keys=["k"])
+    map_specs = [
+        T.TaskSpec(
+            reads=[T.ReadSpec("block", blocks=[b], schema_ipc=schema_ipc)],
+            output=map_out,
+            partition_index=i,
+        )
+        for i, b in enumerate(mat.blocks)
+    ]
+    map_results = planner.submit(map_specs)
+    owners = {
+        store.owner_of(res.blocks[0])
+        for res in map_results
+        if res.blocks and res.blocks[0] is not None
+    }
+    victim = next(h for h in session.executors if h._actor_id in owners)
+    before = _reexecuted()
+    chaos.kill_executor(session, handle=victim)
+    time.sleep(0.5)
+
+    reduce_reads = T.build_shuffle_reads(map_results, 3, schema_ipc)
+    reduce_specs = [
+        T.TaskSpec(
+            reads=[reduce_reads[r]],
+            merge=T.MergeSpec("none"),
+            output=T.OutputSpec("count"),
+            partition_index=r,
+        )
+        for r in range(3)
+    ]
+    out = planner.submit(reduce_specs)
+    assert sum(r.count for r in out) == 30_000
+    # ≤ one map round re-executed (+ transitive source re-materialization)
+    assert 1 <= _reexecuted() - before <= len(map_specs) * 2
+
+
+def test_recovery_stats_land_in_last_query_stats(session):
+    """A query that recovers reports it in last_query_stats['recovery']."""
+    src = session.range(10_000, num_partitions=4).with_column(
+        "v", F.col("id") * 2
+    )
+    ds = dataframe_to_dataset(src)
+    victim = chaos.block_owner_executor(session, ds)
+    chaos.kill_executor(session, handle=victim)
+    time.sleep(0.5)
+    df = dataset_to_dataframe(session, ds)
+    assert df.count() == 10_000
+    recovery = session.last_query_stats["recovery"]
+    assert recovery["reexecuted_tasks"] >= 1
+    assert recovery["recovered_blocks"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# dead-owner fast path (head-bypass satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_dead_owner_fastpath_skips_head_round_trip(session):
+    """A stale CACHED location whose owner is known dead raises
+    OwnerDiedError with ZERO head RPCs — no wasted round trip before
+    lineage recovery triggers."""
+    from raydp_tpu import obs
+
+    src = session.range(500, num_partitions=1).with_column(
+        "v", F.col("id") + 1
+    )
+    ds = dataframe_to_dataset(src)
+    ref = ds.blocks[0]
+    owner = store.owner_of(ref)
+    # warm the DRIVER's location cache through a real read
+    assert T.read_table_block(ref).num_rows == 500
+    meta = store.cached_location(ref.object_id)
+    assert meta is not None and meta.get("cached")
+
+    victim = next(h for h in session.executors if h._actor_id == owner)
+    victim.kill(no_restart=True)
+    store.note_owner_dead(owner)
+    # wait for the head's owner-death unlink to land: the STALE cached
+    # entry over a gone segment is exactly what the fast path fires on
+    import os
+
+    deadline = time.monotonic() + 10
+    while os.path.exists("/dev/shm" + ref.shm_name):
+        assert time.monotonic() < deadline
+        time.sleep(0.05)
+    assert store.cached_location(ref.object_id) is not None
+
+    calls_before = obs.metrics.counter("rpc.client.calls").value
+    with pytest.raises(OwnerDiedError) as excinfo:
+        store.get_buffer(ref)
+    assert obs.metrics.counter("rpc.client.calls").value == calls_before
+    assert getattr(excinfo.value, "object_ids", None) == [ref.object_id]
+
+
+def test_owner_died_error_carries_structured_fields(session):
+    """The head's OwnerDiedError names the object AND the dead owner across
+    the RPC boundary — what feeds lineage recovery and the dead-owner set."""
+    src = session.range(200, num_partitions=1).with_column(
+        "v", F.col("id") + 1
+    )
+    ds = dataframe_to_dataset(src)
+    ref = ds.blocks[0]
+    owner = store.owner_of(ref)
+    victim = next(h for h in session.executors if h._actor_id == owner)
+    victim.kill(no_restart=True)
+    time.sleep(0.8)
+    store.evict_location(ref.object_id)
+    with pytest.raises(OwnerDiedError) as excinfo:
+        store._lookup(ref, fresh=True)
+    assert excinfo.value.object_ids == [ref.object_id]
+    assert excinfo.value.owner == owner
+    # the head reply itself fed the dead-owner registry
+    assert store.owner_known_dead(owner)
+
+
+# ---------------------------------------------------------------------------
+# budget / fail-fast
+# ---------------------------------------------------------------------------
+
+
+def test_recovery_budget_fails_fast(session):
+    """A flapping cluster must not loop: with the re-execution budget at 0,
+    the first lost-block recovery fails fast with the ORIGINAL error."""
+    src = session.range(5_000, num_partitions=2).with_column(
+        "v", F.col("id") + 1
+    )
+    ds = dataframe_to_dataset(src)
+    victim = chaos.block_owner_executor(session, ds)
+    chaos.kill_executor(session, handle=victim)
+    time.sleep(0.5)
+    planner = session._planner
+    planner.recovery_budget = 0
+    try:
+        with pytest.raises(ClusterError):
+            dataset_to_dataframe(session, ds).count()
+    finally:
+        planner.recovery_budget = 64
+    # with the budget restored the same query recovers
+    assert dataset_to_dataframe(session, ds).count() == 5_000
+
+
+def test_deliberate_deletion_is_not_resurrected(session):
+    """Deletion is not loss: a block the head reports cleanly absent (no
+    owner-death tombstone) must NOT be lineage-recovered — resurrecting it
+    would silently undo the deletion and leak the re-registered segment.
+    (Recoverable datasets still re-materialize deleted blocks, via their
+    explicit recover_plan — see test_recoverable_dataset_after_total_loss.)"""
+    src = session.range(3_000, num_partitions=2).with_column(
+        "v", F.col("id") + 1
+    )
+    ds = dataframe_to_dataset(src)
+    store.delete(ds.blocks)
+    with pytest.raises(ClusterError):
+        dataset_to_dataframe(session, ds).count()
+
+
+def test_lineage_recovery_conf_off_propagates_loss(session):
+    """planner.lineage_recovery=False restores the pre-lineage behavior:
+    the lost-block error propagates."""
+    src = session.range(2_000, num_partitions=2).with_column(
+        "v", F.col("id") + 1
+    )
+    ds = dataframe_to_dataset(src)
+    victim = chaos.block_owner_executor(session, ds)
+    chaos.kill_executor(session, handle=victim)
+    time.sleep(0.5)
+    planner = session._planner
+    planner.lineage_recovery = False
+    try:
+        with pytest.raises(ClusterError):
+            dataset_to_dataframe(session, ds).count()
+    finally:
+        planner.lineage_recovery = True
+
+
+def test_scale_out_prunes_dead_handles(session):
+    """An out-of-band executor death leaves a corpse handle in the pool;
+    restoring the pool to N must first prune it and yield N LIVE executors
+    (found live by the package-boundary verify: the no-op 'restore' left a
+    1-alive/1-dead pool that later went fully dead)."""
+    from raydp_tpu.cluster.common import ActorState
+
+    chaos.kill_executor(session, index=0)
+    time.sleep(0.3)
+    assert session.request_total_executors(2) == 2
+    states = [h.state() for h in session.executors]
+    assert states == [ActorState.ALIVE, ActorState.ALIVE], states
+    assert session.range(5_000, num_partitions=4).count() == 5_000
+
+
+# ---------------------------------------------------------------------------
+# proactive unregister at intentional kill (head satellite)
+# ---------------------------------------------------------------------------
+
+
+def test_intentional_kill_unregisters_blocks_at_head(session):
+    """kill(no_restart=True) must not leave the victim's block metadata
+    lingering at the head: the records are popped (tombstoned) at death,
+    and a read raises OwnerDiedError immediately."""
+    from raydp_tpu.cluster import api as cluster_api
+
+    src = session.range(1_000, num_partitions=2).with_column(
+        "v", F.col("id") + 1
+    )
+    ds = dataframe_to_dataset(src)
+    victim = chaos.block_owner_executor(session, ds)
+    victim_blocks = [
+        b for b in ds.blocks if store.owner_of(b) == victim._actor_id
+    ]
+    assert victim_blocks
+    victim.kill(no_restart=True)
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        # owner_of is None once the meta is POPPED (not merely marked)
+        if all(
+            store.owner_of(b) is None for b in victim_blocks
+        ):
+            break
+        time.sleep(0.05)
+    assert all(store.owner_of(b) is None for b in victim_blocks)
+    # but the ids are tombstoned: lookups raise OwnerDiedError, not a
+    # silent not-found (the parity semantics survive the unregister)
+    with pytest.raises(OwnerDiedError):
+        cluster_api.head_rpc(
+            "object_lookup", object_id=victim_blocks[0].object_id
+        )

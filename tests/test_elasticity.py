@@ -103,3 +103,82 @@ def test_recoverable_dataset_after_total_loss(session):
     store.delete(ds.blocks)
     recovered = ds.to_arrow().sort_by("id").column("v").to_pylist()
     assert recovered == expected
+
+
+# ---------------------------------------------------------------------------
+# elastic executor pool (kill-vs-crash note: an intentional kill —
+# kill(no_restart=True) / kill_executors — is FINAL: the head unregisters
+# the victim's blocks and only lineage/reown can bring data back; a crash
+# (_crash above) restarts the actor and its shm survives. The tests above
+# pin the crash half; these pin the intentional half + scaling.)
+# ---------------------------------------------------------------------------
+
+
+def test_scale_out_rides_warm_zygote_fork(session):
+    """Scale-out must be warm-fork fast (sub-second on the bench box; the
+    CI bound is deliberately looser — a loaded runner still beats the
+    ~2.6s cold interpreter start by an order of magnitude)."""
+    from raydp_tpu import obs
+
+    before = obs.metrics.counter("cluster.scale_out").value
+    t0 = time.monotonic()
+    total = session.request_total_executors(3)
+    elapsed = time.monotonic() - t0
+    assert total == 3
+    assert elapsed < 2.0, f"scale-out took {elapsed:.2f}s (cold spawn?)"
+    assert obs.metrics.counter("cluster.scale_out").value == before + 1
+    # the new executor serves work immediately
+    assert session.range(999, num_partitions=6).count() == 999
+    session.kill_executors(1, min_keep=2)
+
+
+def test_scale_in_block_holder_loses_no_data(session):
+    """Graceful scale-in of a block-HOLDING executor: ownership re-owns to
+    the session master first, so the dataset survives the kill."""
+    from raydp_tpu import obs
+    from raydp_tpu.store import object_store as store
+
+    df = session.range(4_000, num_partitions=4).with_column(
+        "w", F.col("id") * 2
+    )
+    ds = dataframe_to_dataset(df)
+    owners = {store.owner_of(b) for b in ds.blocks}
+    tail = session.executors[-1]._actor_id
+    assert tail in owners  # the victim really holds blocks
+    before = obs.metrics.counter("cluster.scale_in").value
+    session.kill_executors(1, min_keep=1)
+    assert obs.metrics.counter("cluster.scale_in").value == before + 1
+    # blocks were re-owned, not lost: no lineage re-execution needed
+    assert ds.to_arrow().num_rows == 4_000
+    # and queries over them keep working on the shrunken pool
+    from raydp_tpu.exchange import dataset_to_dataframe
+
+    assert dataset_to_dataframe(session, ds).count() == 4_000
+
+
+def test_sustained_queue_depth_gates_scale_out():
+    """dynamicAllocation.sustainedStages=2: one wide stage (a burst) does
+    not grow the pool; the second consecutive wide stage does."""
+    import raydp_tpu
+
+    raydp_tpu.stop_etl()
+    s = raydp_tpu.init_etl(
+        "test-elastic-sustained",
+        num_executors=1,
+        executor_cores=1,
+        executor_memory="200M",
+        configs={
+            "etl.dynamicAllocation.enabled": "true",
+            "etl.dynamicAllocation.maxExecutors": 2,
+            "etl.dynamicAllocation.tasksPerSlot": 1,
+            "etl.dynamicAllocation.idleTimeout": 3600,
+            "etl.dynamicAllocation.sustainedStages": 2,
+        },
+    )
+    try:
+        assert s.range(600, num_partitions=6).count() == 600
+        assert len(s.executors) == 1, "one wide stage must not scale out"
+        assert s.range(600, num_partitions=6).count() == 600
+        assert len(s.executors) == 2, "sustained depth must scale out"
+    finally:
+        raydp_tpu.stop_etl()

@@ -134,7 +134,7 @@ def test_last_query_stats_schema(traced_session):
     stats = traced_session.last_query_stats
     assert set(stats) == {
         "seconds", "output_partitions", "stages", "fusion", "shuffle",
-        "plan_cache", "rpc",
+        "plan_cache", "rpc", "recovery",
     }
     assert stats["seconds"] > 0
     assert stats["output_partitions"] >= 1
@@ -147,6 +147,11 @@ def test_last_query_stats_schema(traced_session):
         stats["rpc"]
     )
     assert stats["rpc"]["actor_dispatches"] >= 1
+    # lineage-recovery accounting (docs/fault_tolerance.md): both keys are
+    # PINNED and zero on a healthy query — the happy path pays no recovery
+    assert set(stats["recovery"]) == {"reexecuted_tasks", "recovered_blocks"}
+    assert stats["recovery"]["reexecuted_tasks"] == 0
+    assert stats["recovery"]["recovered_blocks"] == 0
     for stage in stats["stages"]:
         # per-stage schema: task count, wall seconds, locality + dispatch
         # mode, and the server-side read/compute/emit phase split
@@ -227,6 +232,27 @@ def test_dump_metrics_merges_processes(traced_session):
         name for snap in merged.values() for name in snap
     }
     assert "etl.tasks_run" in flat
+
+
+def test_recovery_and_elasticity_counters_in_dump_metrics(traced_session):
+    """The fault-tolerance counters are part of the pinned metrics surface:
+    retry/recovery/scaling activity must be attributable from
+    dump_metrics() alone (zero-valued when nothing failed — the session
+    touches them at boot exactly so the keys always exist)."""
+    assert traced_session.range(100, num_partitions=2).count() == 100
+    merged = raydp_tpu.dump_metrics()
+    driver_key = next(k for k in merged if k.startswith("driver:"))
+    snap = merged[driver_key]
+    for name in (
+        "etl.task_retries",
+        "lineage.reexecuted_tasks",
+        "lineage.recovered_blocks",
+        "cluster.scale_out",
+        "cluster.scale_in",
+    ):
+        assert name in snap, name
+        assert snap[name]["type"] == "counter"
+        assert snap[name]["value"] >= 0
 
 
 def test_trace_disabled_leaves_stats_working(traced_session):

@@ -1,0 +1,432 @@
+"""Chaos-mode test harness: make executor failure boring.
+
+SIGKILLs executors mid-shuffle, mid-compiled-dispatch, and mid-streaming-fit
+(intentional kills — the head unregisters the victim's blocks, so the loss
+is REAL, unlike a restartable crash whose shm survives) and asserts that
+
+- every query/fit completes with a result byte-identical to an unkilled run
+  (lineage recovery, docs/fault_tolerance.md),
+- ``lineage.reexecuted_tasks`` stays within one map round per production
+  level per kill, and
+- the PR 4/5 runtime sanitizers (``RAYDP_TPU_SANITIZE=donation,lockdep,
+  leaks-strict``) stay clean — the leak/lockdep auditors double as a
+  recovery-correctness oracle. Gated: ZERO leaked shm segments / spill
+  files at the strict shutdown audit, ZERO stranded threads per scenario;
+  fd counts ride the report as advisory (the sanitize design's own stance
+  on raw fd deltas).
+
+Usage::
+
+    RAYDP_TPU_SANITIZE=donation,lockdep,leaks-strict \
+        python -m tools.chaos --quick --json chaos_report.json
+
+``--quick`` runs the CI slice (one mid-shuffle kill + one mid-fit kill);
+without it the full scenario list runs (adds the compiled-dispatch kill and
+the elasticity round-trip). Exit code is non-zero when any query went
+unrecovered or any sanitizer finding surfaced. The same scenario bodies are
+reused by ``tests/test_chaos.py`` via the importable helpers below.
+"""
+# raydp-lint: disable-file=print-diagnostics (standalone CI tool: its stdout IS the report, there is no obs role to tag)
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+from typing import Callable, List, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO not in sys.path:
+    sys.path.insert(0, REPO)
+
+
+# ---------------------------------------------------------------------------
+# primitives (importable by tests/test_chaos.py)
+# ---------------------------------------------------------------------------
+
+
+def kill_executor(session, handle=None, index: int = 0):
+    """SIGKILL one executor with NO restart — the real-loss chaos primitive:
+    the head unregisters (tombstones) its blocks and unlinks their segments,
+    so any surviving reference must come back through lineage recovery. The
+    dead owner is recorded in the store so stale head-bypass locations
+    fast-path to OwnerDiedError. Returns the victim handle."""
+    from raydp_tpu.store import object_store as store
+
+    victim = handle if handle is not None else session.executors[index]
+    victim.kill(no_restart=True)
+    store.note_owner_dead(victim._actor_id)
+    return victim
+
+
+def delayed_kill(session, delay_s: float, index: int = 0) -> threading.Thread:
+    """Arm a timer thread that SIGKILLs an executor mid-whatever-is-running.
+    Join it after the workload completes."""
+
+    def _fire():
+        time.sleep(delay_s)
+        try:
+            kill_executor(session, index=index)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (chaos timer: the victim may already be gone, racing scenario teardown)
+            pass
+
+    thread = threading.Thread(target=_fire, name="chaos-killer", daemon=True)
+    thread.start()
+    return thread
+
+
+def block_owner_executor(session, ds):
+    """An executor handle that owns at least one of the dataset's blocks
+    (killing it makes the loss real), or None."""
+    from raydp_tpu.store import object_store as store
+
+    owners = {store.owner_of(b) for b in ds.blocks}
+    for handle in session.executors:
+        if handle._actor_id in owners:
+            return handle
+    return None
+
+
+def lineage_counters() -> dict:
+    from raydp_tpu import obs
+
+    return {
+        "reexecuted_tasks": int(
+            obs.metrics.counter("lineage.reexecuted_tasks").value
+        ),
+        "recovered_blocks": int(
+            obs.metrics.counter("lineage.recovered_blocks").value
+        ),
+    }
+
+
+def sanitizer_report() -> dict:
+    """The current process's leak inventory (the cluster-level audit runs at
+    shutdown; chaos scenarios also sample between kills)."""
+    from raydp_tpu import sanitize
+
+    if not sanitize.leaks_enabled():
+        return {"enabled": False}
+    report = sanitize.leak_report()
+    return {
+        "enabled": True,
+        "shm": len(report["shm"]),
+        "spill": len(report["spill"]),
+        "fds": report["fds"],
+        "threads": report["threads"],
+    }
+
+
+# ---------------------------------------------------------------------------
+# scenarios
+# ---------------------------------------------------------------------------
+
+
+def _fresh_session(name: str, executors: int = 2):
+    import raydp_tpu
+
+    return raydp_tpu.init_etl(
+        name, num_executors=executors, executor_cores=1,
+        executor_memory="300M",
+    )
+
+
+def scenario_mid_shuffle(rows: int = 120_000) -> dict:
+    """Kill a block-holding executor between a shuffle's map and reduce
+    rounds (deterministic window: the map outputs exist, the reduce hasn't
+    read them) and while a full query is in flight (timed kill)."""
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+
+    session = _fresh_session("chaos-shuffle")
+    try:
+        # deterministic half: a shuffle whose SOURCE blocks are executor-
+        # owned loses real data when the owner dies — the map round must
+        # lineage-recover them mid-exchange
+        src = session.range(rows, num_partitions=8).with_column(
+            "k", F.col("id") % 13
+        )
+        ds = dataframe_to_dataset(src)
+        df = dataset_to_dataframe(session, ds)
+        clean = df.group_by("k").count().sort("k").collect()
+        before = lineage_counters()
+        victim = block_owner_executor(session, ds)
+        kill_executor(session, handle=victim)
+        time.sleep(0.3)
+        chaos = df.group_by("k").count().sort("k").collect()
+        session.request_total_executors(2)  # restore the pool
+
+        # racing half: a timed kill lands wherever it lands (map dispatch,
+        # between rounds, reduce read) — every window must hold
+        killer = delayed_kill(session, 0.05, index=0)
+        chaos2 = df.group_by("k").count().sort("k").collect()
+        killer.join()
+        session.request_total_executors(2)
+
+        after = lineage_counters()
+        reexecuted = after["reexecuted_tasks"] - before["reexecuted_tasks"]
+        identical = chaos == clean and chaos2 == clean
+        # bound: ≤ one map round (8 tasks) per production LEVEL per kill —
+        # recovery transitively re-materializes the lost blocks' source
+        # inputs too (one extra level here), and this scenario injects TWO
+        # kills: 8 × 2 levels × 2 kills
+        bound = 32
+        return {
+            "name": "mid_shuffle_kill",
+            "ok": bool(identical and reexecuted >= 1),
+            "byte_identical": bool(identical),
+            "reexecuted_tasks": reexecuted,
+            "reexecution_bound": bound,
+            "within_bound": reexecuted <= bound,
+        }
+    finally:
+        raydp_tpu.stop_etl()
+
+
+def scenario_mid_compiled(rows: int = 50_000) -> dict:
+    """Kill the owner of a materialized dataset's blocks, then run a
+    COMPILED (plan-cache + run_plan) query over it: the compiled dispatch's
+    lost-block fallback must lineage-recover and re-run."""
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+
+    session = _fresh_session("chaos-compiled")
+    try:
+        src = session.range(rows, num_partitions=4).with_column(
+            "x", F.col("id") * 3
+        )
+        ds = dataframe_to_dataset(src)
+        df = dataset_to_dataframe(session, ds)
+        clean = df.filter(F.col("x") % 2 == 0).count()
+        before = lineage_counters()
+
+        victim = block_owner_executor(session, ds)
+        assert victim is not None
+        kill_executor(session, handle=victim)
+        time.sleep(0.5)
+        chaos = df.filter(F.col("x") % 2 == 0).count()
+        session.request_total_executors(2)
+
+        after = lineage_counters()
+        reexecuted = after["reexecuted_tasks"] - before["reexecuted_tasks"]
+        return {
+            "name": "mid_compiled_dispatch_kill",
+            "ok": chaos == clean and reexecuted >= 1,
+            "byte_identical": chaos == clean,
+            "reexecuted_tasks": reexecuted,
+            "reexecution_bound": 4,
+            "within_bound": reexecuted <= 4,
+        }
+    finally:
+        raydp_tpu.stop_etl()
+
+
+def scenario_mid_fit(rows: int = 2048) -> dict:
+    """SIGKILL the executor owning the training blocks mid-streaming-fit:
+    the streaming iterator's block reads lineage-recover and the fit's
+    final params must be byte-identical to an unkilled run."""
+    import numpy as np
+    import pandas as pd
+
+    import raydp_tpu
+    from raydp_tpu.exchange import dataframe_to_dataset
+
+    def _fit(session, ds, kill_after_steps: Optional[int]) -> dict:
+        import jax
+
+        from raydp_tpu.estimator import JaxEstimator
+        from raydp_tpu.models import MLPRegressor
+
+        est = JaxEstimator(
+            model=MLPRegressor(),
+            optimizer="adam",
+            loss="mse",
+            feature_columns=["a", "b"],
+            label_column="y",
+            batch_size=256,
+            num_epochs=2,
+            learning_rate=1e-3,
+            shuffle=True,
+            seed=0,
+            streaming=True,
+            donate_state=False,
+        )
+        if kill_after_steps is not None:
+            # lose the blocks FOR REAL before the stream starts: the fit's
+            # block reads then recover through lineage WHILE it runs (a
+            # timed kill on data this small usually lands after the last
+            # read and proves nothing)
+            victim = block_owner_executor(session, ds)
+            if victim is not None:
+                kill_executor(session, handle=victim)
+                time.sleep(0.3)
+        est.fit(ds)
+        params = est.get_model().params
+        leaves = jax.tree_util.tree_leaves(params)
+        return {
+            "digest": [float(np.asarray(leaf).sum()) for leaf in leaves],
+            "raw": [np.asarray(leaf).copy() for leaf in leaves],
+        }
+
+    session = _fresh_session("chaos-fit")
+    try:
+        rng = np.random.default_rng(3)
+        pdf = pd.DataFrame(
+            {
+                "a": rng.random(rows).astype(np.float32),
+                "b": rng.random(rows).astype(np.float32),
+            }
+        )
+        pdf["y"] = 2 * pdf["a"] + 3 * pdf["b"]
+        df = session.from_pandas(pdf, num_partitions=4)
+        # materialize through the EXECUTORS so the blocks are executor-owned
+        # (a from_pandas source is driver-owned — killing an executor would
+        # lose nothing); repartition keeps the rows bit-identical
+        ds = dataframe_to_dataset(df.repartition(4))
+        clean = _fit(session, ds, kill_after_steps=None)
+        before = lineage_counters()
+        chaos = _fit(session, ds, kill_after_steps=1)
+        session.request_total_executors(2)
+        after = lineage_counters()
+        identical = all(
+            np.array_equal(c, k) for c, k in zip(clean["raw"], chaos["raw"])
+        )
+        reexecuted = after["reexecuted_tasks"] - before["reexecuted_tasks"]
+        return {
+            "name": "mid_streaming_fit_kill",
+            "ok": bool(identical and reexecuted >= 1),
+            "byte_identical": bool(identical),
+            "reexecuted_tasks": reexecuted,
+            "reexecution_bound": 8,
+            "within_bound": reexecuted <= 8,
+        }
+    finally:
+        raydp_tpu.stop_etl()
+
+
+def scenario_elasticity() -> dict:
+    """Scale-out under sustained queue depth (warm zygote fork — timed),
+    then scale-in of a block-holding executor: no query may lose data."""
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.exchange import dataframe_to_dataset
+
+    session = _fresh_session("chaos-elastic", executors=1)
+    try:
+        t0 = time.perf_counter()
+        session.request_total_executors(2)
+        scale_out_s = time.perf_counter() - t0
+        # materialize AFTER the scale-out so blocks land on both executors;
+        # kill_executors takes victims from the pool's tail — the new
+        # executor — which then holds blocks (the scale-in-with-data case)
+        df = session.range(20_000, num_partitions=4).with_column(
+            "v", F.col("id") + 1
+        )
+        ds = dataframe_to_dataset(df)
+        expected = ds.count()
+        session.kill_executors(1, min_keep=1)
+        survived = ds.to_arrow().num_rows == expected
+        ok = survived and len(session.executors) >= 1
+        return {
+            "name": "elastic_round_trip",
+            "ok": bool(ok),
+            "scale_out_s": round(scale_out_s, 3),
+            "scale_out_warm": scale_out_s < 1.0,
+            "data_survived_scale_in": bool(survived),
+        }
+    finally:
+        raydp_tpu.stop_etl()
+
+
+QUICK = (scenario_mid_shuffle, scenario_mid_fit)
+FULL = (
+    scenario_mid_shuffle,
+    scenario_mid_compiled,
+    scenario_mid_fit,
+    scenario_elasticity,
+)
+
+
+def run(scenarios) -> dict:
+    from raydp_tpu import sanitize
+    from raydp_tpu.cluster import api as cluster_api
+
+    results: List[dict] = []
+    for scenario in scenarios:
+        name = scenario.__name__
+        t0 = time.perf_counter()
+        try:
+            entry = scenario()
+        except Exception as exc:  # one scenario must not hide the rest
+            entry = {"name": name, "ok": False, "error": repr(exc)[:500]}
+        entry["seconds"] = round(time.perf_counter() - t0, 2)
+        # leak inventory AFTER the scenario's session stopped. GATED here:
+        # stranded THREADS (stable zero — a recovery that leaks a producer
+        # or reaper thread shows up immediately). Reported only: fds (the
+        # sanitize design treats raw fd counts as advisory — library
+        # internals open them unpredictably) and shm/spill (driver-owned
+        # blocks legitimately live until cluster shutdown, where the
+        # leaks-strict audit below is exact and fatal).
+        entry["sanitizer"] = sanitizer_report()
+        if entry["sanitizer"].get("threads"):
+            entry["ok"] = False
+            entry["sanitizer_fail"] = (
+                f"{entry['sanitizer']['threads']} stranded thread(s)"
+            )
+        results.append(entry)
+        print(f"[chaos] {entry.get('name', name)}: "
+              f"{'OK' if entry.get('ok') else 'FAILED'} "
+              f"({entry['seconds']}s)")
+    # final teardown audit: leaks-strict raises on any leaked segment —
+    # the recovery-correctness oracle the harness exists to arm
+    sanitizer_findings = 0
+    try:
+        cluster_api.shutdown()
+    except sanitize.LeakError as exc:
+        sanitizer_findings += 1
+        results.append({"name": "shutdown_leak_audit", "ok": False,
+                        "error": str(exc)[:500]})
+    except Exception as exc:
+        # any OTHER teardown failure must still land in the report — the
+        # CI artifact is most valuable exactly when chaos broke teardown
+        results.append({"name": "cluster_shutdown", "ok": False,
+                        "error": repr(exc)[:500]})
+    unrecovered = sum(1 for r in results if not r.get("ok"))
+    return {
+        "sanitize_modes": os.environ.get("RAYDP_TPU_SANITIZE", ""),
+        "scenarios": results,
+        "unrecovered_queries": unrecovered,
+        "sanitizer_findings": sanitizer_findings,
+        "ok": unrecovered == 0 and sanitizer_findings == 0,
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument("--quick", action="store_true",
+                        help="CI slice: one mid-shuffle + one mid-fit kill")
+    parser.add_argument("--json", default="chaos_report.json",
+                        help="report artifact path")
+    args = parser.parse_args(argv)
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.setdefault(
+        "RAYDP_TPU_SANITIZE", "donation,lockdep,leaks-strict"
+    )
+    report = run(QUICK if args.quick else FULL)
+    with open(args.json, "w") as f:
+        json.dump(report, f, indent=2, default=str)
+    print(json.dumps({k: v for k, v in report.items() if k != "scenarios"}))
+    if not report["ok"]:
+        print("CHAOS FAIL", file=sys.stderr)
+        return 1
+    print("CHAOS OK")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -28,7 +28,12 @@ when:
   fits are dispatch/compile-dominated, which deflates BOTH ratios
   against the snapshot's 10x-bigger run, while "hybrid regressed below
   the uncached path" (the r06 symptom this gate exists for) shows up in
-  the quotient at any scale.
+  the quotient at any scale;
+- the recovery probe failed (``recovery_probe.ok`` false): a query with
+  one injected executor SIGKILL must come back correct through lineage
+  recovery with ≥1 re-executed task. ``recovery_overhead`` itself is
+  reported, not gated — but the etl_query_s/burst gates above hold the
+  CLEAN path to <25% regression, i.e. lineage bookkeeping must be ~free.
 
 Usage: ``python tools/perf_smoke.py [artifact.json]``
 """
@@ -107,6 +112,8 @@ def main() -> int:
             "streaming_hybrid_pipeline", {}
         ),
         "streaming_ingest_probe": detail.get("streaming_ingest_probe", {}),
+        "recovery_probe": detail.get("recovery_probe", {}),
+        "recovery_overhead": detail.get("recovery_overhead"),
         "etl_breakdown": detail.get("etl_breakdown", {}),
         "shuffle_probe": detail.get("shuffle_probe", {}),
         "reference_etl_query_s": reference,
@@ -170,6 +177,13 @@ def main() -> int:
                 f"{REGRESSION_BUDGET:.0%}: hybrid regressed vs the "
                 "uncached path)"
             )
+    recovery = artifact["recovery_probe"]
+    if recovery and not recovery.get("ok"):
+        failures.append(
+            f"recovery probe failed: {recovery} (a query with one injected "
+            "executor SIGKILL must recover byte-correct via lineage with "
+            "≥1 re-executed task)"
+        )
     for entry in artifact["shuffle_probe"].get("shuffle", []):
         if entry.get("indexed") and entry["blocks"] > entry["map_tasks"]:
             failures.append(
