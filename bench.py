@@ -184,6 +184,9 @@ def bench_framework(n_rows: int, batch: int, epochs: int):
     cmp["streaming_ingest_probe"] = ingest_probe
     cmp["recovery_probe"] = rec_probe
     cmp["recovery_overhead"] = rec_probe.get("recovery_overhead")
+    cmp["recovery_overhead_service_on"] = rec_probe.get(
+        "recovery_overhead_service_on"
+    )
     cmp.update(burst)
     cmp.update(
         fair_e2e_fields(pandas_taxi_etl, pdf, trained, t_boot, t_query, cmp)
@@ -231,67 +234,115 @@ def streaming_ingest_probe(ds, batch: int) -> dict:
 
 
 def recovery_probe(session, df) -> dict:
-    """``recovery_overhead``: the same query with ONE injected executor
-    SIGKILL (no restart — the head unregisters the victim's blocks, so the
-    loss is real) vs the clean run on the same data. Lineage recovery
-    (docs/fault_tolerance.md) re-executes just the lost producing tasks and
-    rebinds; the probe reports the wall-clock ratio, the re-execution count,
-    and correctness. Separately timed, EXCLUDED from etl_query_s."""
+    """BOTH recovery tiers (docs/fault_tolerance.md "Ownership tiers"), the
+    same query with ONE injected executor SIGKILL each:
+
+    - ``service_on`` — the default arm: the per-host block service owns the
+      blocks, so executor death loses nothing. Expected ``recovery_overhead``
+      ≈ 1.0x with ZERO re-executed tasks (the handoff must be ~free).
+    - ``service_off`` — the head's service registration is dropped for this
+      arm (store/block_service.deregister_service), restoring PR 8's
+      executor-owned behavior: the kill is real loss and lineage recovery
+      re-executes the producing tasks (~7.6x on a 4.5ms query at r08).
+
+    Reports wall-clock ratios, re-execution counts, and correctness per
+    tier; the top-level ``recovery_overhead`` stays the LINEAGE tier's ratio
+    (continuity with r08's meaning). Separately timed, EXCLUDED from
+    etl_query_s."""
     from raydp_tpu import obs
     from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+    from raydp_tpu.store import block_service as bs
     from raydp_tpu.store import object_store as store
 
     from tools.chaos import block_owner_executor, kill_executor
 
     pool = len(session.executors)
-    ds = dataframe_to_dataset(df.repartition(4))
-    q = dataset_to_dataframe(session, ds)
-    q.count()  # warm-up: compile + cache the plan (interactive_burst does
-    # the same) so clean_s and recovered_s compare warm-vs-warm — a cold
-    # clean run would fold the one-time compile into the denominator and
-    # understate recovery_overhead
-    t0 = time.perf_counter()
-    clean_rows = q.count()
-    clean_s = time.perf_counter() - t0
-    before = obs.metrics.counter("lineage.reexecuted_tasks").value
-    victim = block_owner_executor(session, ds)
-    if victim is None:
-        # nothing executor-owned to lose (stale pool / ownership race):
-        # report a failed probe instead of crashing the whole bench
-        store.delete(ds.blocks)
-        return {"ok": False, "note": "no executor-owned blocks to kill"}
-    kill_executor(session, handle=victim)
-    time.sleep(0.3)  # let the head's owner-death unregister land
-    recovered_rows = None
-    error = None
-    t0 = time.perf_counter()
-    try:
-        # a recovery regression must surface as recovery_probe.ok=false in
-        # the artifact (perf_smoke gates on it), NOT crash the whole bench
-        recovered_rows = q.count()
-    except Exception as exc:
-        error = repr(exc)[:300]
-    recovered_s = time.perf_counter() - t0
-    reexecuted = int(
-        obs.metrics.counter("lineage.reexecuted_tasks").value - before
-    )
-    session.request_total_executors(pool)  # restore for later probes
-    try:
-        store.delete(ds.blocks)
-    except Exception:  # raydp-lint: disable=swallowed-exceptions (probe cleanup best-effort; blocks die with the session)
-        pass
-    out = {
-        "clean_s": round(clean_s, 4),
-        "recovered_s": round(recovered_s, 4),
-        "recovery_overhead": (
-            round(recovered_s / clean_s, 3) if clean_s > 0 else None
-        ),
-        "reexecuted_tasks": reexecuted,
-        "ok": bool(recovered_rows == clean_rows and reexecuted >= 1),
+
+    def one_tier(expect_reexec: bool) -> dict:
+        ds = dataframe_to_dataset(df.repartition(4))
+        q = dataset_to_dataframe(session, ds)
+        q.count()  # warm-up: compile + cache the plan so clean_s and
+        # recovered_s compare warm-vs-warm — a cold clean run would fold the
+        # one-time compile into the denominator and understate the overhead
+        t0 = time.perf_counter()
+        clean_rows = q.count()
+        clean_s = time.perf_counter() - t0
+        before = obs.metrics.counter("lineage.reexecuted_tasks").value
+        if expect_reexec:
+            # the lineage arm needs a victim that OWNS blocks (real loss)
+            victim = block_owner_executor(session, ds)
+        else:
+            # the service arm owns the blocks itself: any executor works
+            # (and none may own blocks — that is the point)
+            victim = session.executors[0] if session.executors else None
+        if victim is None:
+            # nothing suitable to kill (stale pool / ownership race):
+            # report a failed tier instead of crashing the whole bench
+            try:
+                store.delete(ds.blocks)
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (probe cleanup best-effort; blocks die with the session)
+                pass
+            return {"ok": False, "note": "no suitable victim to kill"}
+        kill_executor(session, handle=victim)
+        time.sleep(0.3)  # let the head's owner-death bookkeeping land
+        recovered_rows = None
+        error = None
+        t0 = time.perf_counter()
+        try:
+            # a recovery regression must surface as recovery_probe.ok=false
+            # in the artifact (perf_smoke gates on it), NOT crash the bench
+            recovered_rows = q.count()
+        except Exception as exc:
+            error = repr(exc)[:300]
+        recovered_s = time.perf_counter() - t0
+        reexecuted = int(
+            obs.metrics.counter("lineage.reexecuted_tasks").value - before
+        )
+        session.request_total_executors(pool)  # restore for later probes
+        try:
+            store.delete(ds.blocks)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (probe cleanup best-effort; blocks die with the session)
+            pass
+        out = {
+            "clean_s": round(clean_s, 4),
+            "recovered_s": round(recovered_s, 4),
+            "recovery_overhead": (
+                round(recovered_s / clean_s, 3) if clean_s > 0 else None
+            ),
+            "reexecuted_tasks": reexecuted,
+            "ok": bool(
+                recovered_rows == clean_rows
+                and (reexecuted >= 1 if expect_reexec else reexecuted == 0)
+            ),
+        }
+        if error is not None:
+            out["error"] = error
+        return out
+
+    svc = getattr(session, "block_service", None)
+    if svc is not None:
+        service_on = one_tier(expect_reexec=False)
+        # flip to the PR 8 arm WITHOUT a second session: deregistering at
+        # the head makes future registrations keep executor ownership
+        bs.deregister_service(svc._actor_id)
+        try:
+            service_off = one_tier(expect_reexec=True)
+        finally:
+            try:
+                bs.register_service(svc._actor_id)
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (probe teardown best-effort; the session is stopped right after)
+                pass
+    else:
+        service_on = {"ok": False, "note": "session has no block service"}
+        service_off = one_tier(expect_reexec=True)
+    return {
+        "service_on": service_on,
+        "service_off": service_off,
+        "recovery_overhead": service_off.get("recovery_overhead"),
+        "recovery_overhead_service_on": service_on.get("recovery_overhead"),
+        "reexecuted_tasks": service_off.get("reexecuted_tasks"),
+        "ok": bool(service_on.get("ok") and service_off.get("ok")),
     }
-    if error is not None:
-        out["error"] = error
-    return out
 
 
 def interactive_burst(session, df, n_queries: int) -> dict:

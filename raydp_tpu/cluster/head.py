@@ -136,6 +136,13 @@ class Head:
         self.named: Dict[str, str] = {}  # name -> actor_id; guarded-by: self.lock|self.actor_state_cond
         self.pgs: Dict[str, _PlacementGroup] = {}  # guarded-by: self.lock|self.actor_state_cond
         self.objects: Dict[str, _ObjectMeta] = {}  # guarded-by: self.lock|self.actor_state_cond
+        # owner-kind metadata: shm namespace -> block-service actor id (one
+        # per host — every virtual node on a machine shares /dev/shm, so the
+        # namespace IS the host key). Registrations flagged ``handoff`` are
+        # recorded under the namespace's LIVE service instead of the writing
+        # executor, which is what makes executor death lose zero blocks
+        # (store/block_service.py; docs/fault_tolerance.md).
+        self.block_services: Dict[str, str] = {}  # guarded-by: self.lock|self.actor_state_cond
         # owner-death tombstones: object_id -> dead owner. When an owner
         # dies, its metas are POPPED (proactive unregister — they used to
         # linger as owner_died records until a reader tripped over them)
@@ -781,6 +788,15 @@ class Head:
             obs_metrics.counter("cluster.actor_deaths").inc()
             self.actor_state_cond.notify_all()
             self._on_owner_dead(actor.spec.actor_id)
+            # a DEAD block service must not keep adopting registrations —
+            # drop its owner-kind entry so handoffs fall back to executor
+            # ownership (lineage then covers those blocks, the PR 8 tier)
+            for ns in [
+                ns
+                for ns, a in self.block_services.items()
+                if a == actor.spec.actor_id
+            ]:
+                del self.block_services[ns]
             if actor.spec.name is not None:
                 # keep the name → id mapping so get_actor(name) reports DEAD
                 pass
@@ -813,17 +829,72 @@ class Head:
             self._release_actor_resources(actor)
             actor.pending_respawn = True
 
+    # ---------- block services (per-host owner-of-record actors) ----------
+
+    def handle_block_service_register(self, actor_id: str):
+        """Adopt a spawned BlockService actor as the owner of record for its
+        node's shared-memory namespace. Returns the namespace it serves."""
+        with self.lock:
+            actor = self.actors.get(actor_id)
+            if actor is None:
+                raise ClusterError(f"unknown block-service actor {actor_id}")
+            node = self.nodes.get(actor.node_id) if actor.node_id else None
+            ns = node.shm_ns if node is not None else ""
+            self.block_services[ns] = actor_id
+        obs_instant("block_service.registered", actor_id=actor_id, shm_ns=ns)
+        return ns
+
+    def handle_block_service_unregister(self, actor_id: str):
+        """Drop a service from the owner-kind table (A/B toggle; its already-
+        owned blocks keep their owner — only FUTURE handoffs fall back)."""
+        with self.lock:
+            for ns in [
+                ns for ns, a in self.block_services.items() if a == actor_id
+            ]:
+                del self.block_services[ns]
+        return True
+
+    def handle_block_service_lookup(self, shm_ns: str = ""):
+        with self.lock:
+            return self.block_services.get(shm_ns)
+
+    def _effective_owner(self, owner: str, shm_ns: str, handoff: bool) -> str:  # guarded-by: self.lock|self.actor_state_cond held
+        """The owner of record for a new registration: the namespace's LIVE
+        block service when the writer flagged the entry for handoff, else
+        the writer itself. Deciding HERE (the head knows actor liveness
+        authoritatively) means a dead/bouncing service degrades registrations
+        to executor ownership instead of parking blocks on a corpse owner
+        that no death event will ever GC."""
+        if not handoff:
+            return owner
+        service = self.block_services.get(shm_ns)
+        if service is None or service == owner:
+            return owner
+        actor = self.actors.get(service)
+        if (
+            actor is None
+            or actor.state == ActorState.DEAD
+            or actor.intentional_exit
+        ):
+            return owner
+        obs_metrics.counter("block_service.adopted_blocks").inc()
+        return service
+
     # ---------- object ownership table ----------
 
     def handle_object_put(
         self, object_id: str, owner: str, shm_name: str, size: int,
-        node_id: str, shm_ns: str = "",
+        node_id: str, shm_ns: str = "", handoff: bool = False,
     ):
+        """Register one block. Returns the EFFECTIVE owner (the namespace's
+        block service for handoff entries) so the writer can correct its
+        location cache and the metas it pushes to peers."""
         with self.lock:
+            owner = self._effective_owner(owner, shm_ns, handoff)
             self.objects[object_id] = _ObjectMeta(
                 object_id, owner, shm_name, size, node_id, shm_ns
             )
-            return True
+            return owner
 
     # a proxied put whose client died between chunk RPCs and commit would
     # otherwise pin up to the full object size in head memory forever; the
@@ -911,7 +982,7 @@ class Head:
             fetch_addr = node.agent_addr
         else:
             fetch_addr = self.tcp_addr
-        return {
+        view = {
             "shm_name": meta.shm_name,
             "size": meta.size,
             "owner": meta.owner,
@@ -919,6 +990,20 @@ class Head:
             "shm_ns": meta.shm_ns,
             "fetch_addr": fetch_addr,
         }
+        # service-owned block: advertise the owner's own socket so remote
+        # readers can pull from the first-class owner (TCP only — same-host
+        # readers map shm directly and never fetch). fetch_addr stays the
+        # agent/head fallback for the service's restart window.
+        if meta.owner == self.block_services.get(meta.shm_ns):
+            actor = self.actors.get(meta.owner)
+            if (
+                actor is not None
+                and actor.state == ActorState.ALIVE
+                and actor.sock_path
+                and actor.sock_path.startswith("tcp://")
+            ):
+                view["service_addr"] = actor.sock_path
+        return view
 
     def handle_object_lookup(self, object_id: str):
         with self.lock:
@@ -934,14 +1019,22 @@ class Head:
         """Vectorized registration: one RPC frame registers every block a
         task batch produced (the per-block object_put is the hot metadata
         call of the shuffle map side — M×R frames collapse to one per
-        task)."""
+        task). Returns ``{object_id: effective_owner}`` for the entries the
+        block-service handoff reassigned (empty on the non-handoff path),
+        so the writer's cache stays truthful in the same round trip."""
+        reassigned: Dict[str, str] = {}
         with self.lock:
             for e in entries:
+                owner = self._effective_owner(
+                    e["owner"], e.get("shm_ns", ""), bool(e.get("handoff"))
+                )
+                if owner != e["owner"]:
+                    reassigned[e["object_id"]] = owner
                 self.objects[e["object_id"]] = _ObjectMeta(
-                    e["object_id"], e["owner"], e["shm_name"], e["size"],
+                    e["object_id"], owner, e["shm_name"], e["size"],
                     e["node_id"], e.get("shm_ns", ""),
                 )
-        return True
+        return reassigned
 
     def _batch_meta(self, oid: str, lease: bool):  # guarded-by: self.lock|self.actor_state_cond held
         """One batch entry. Tombstones were already handled: both callers

@@ -54,6 +54,14 @@ class EtlExecutor:
             str(self.configs.get("planner.head_bypass", "true")).lower()
             in ("1", "true", "yes")
         )
+        # block-service handoff (store/block_service.py): THIS process's
+        # registrations flag completed blocks for per-host service ownership
+        # — executor death then loses zero blocks. Conf-off (the A/B arm)
+        # must reach executors too, or their writes would still hand off.
+        _store.set_block_service(
+            str(self.configs.get("store.block_service", "true")).lower()
+            in ("1", "true", "yes")
+        )
         self._warm_up()
 
     def _pool(self):

@@ -135,6 +135,36 @@ class EtlSession:
             max_restarts=0, light=self._light_actors, block=False,
         )
 
+        # per-host block service (store/block_service.py): the owner of
+        # record for completed executor blocks, so executor SIGKILL loses
+        # zero blocks and scale-in needs no reown sweep. Spawned non-blocking
+        # (zygote warm fork, like every light actor) and REGISTERED at the
+        # head after the readiness barrier below — before any query runs.
+        # max_restarts=3: the service is stateless (segments live in
+        # /dev/shm, ownership at the head), so a crash-restart with the same
+        # identity loses nothing; only an intentional kill is real loss
+        # (→ lineage recovery). ``store.block_service`` conf, default ON;
+        # OFF restores PR 8's executor-owned behavior byte-for-byte.
+        self._block_service_enabled = str(
+            self.configs.get("store.block_service", "true")
+        ).lower() in ("1", "true", "yes")
+        self.block_service = None
+        if self._block_service_enabled:
+            from raydp_tpu.store.block_service import (
+                BLOCK_SERVICE_SUFFIX,
+                BlockService,
+            )
+
+            self.block_service = cluster.spawn(
+                BlockService,
+                app_name,
+                name=f"{app_name}{BLOCK_SERVICE_SUFFIX}",
+                max_restarts=3,
+                max_concurrency=4,
+                light=self._light_actors,
+                block=False,
+            )
+
         # executor pool: restartable actors (parity: setMaxRestarts(3),
         # RayExecutorUtils.java:63); +1 concurrency for data-plane reads
         # (parity: setMaxConcurrency(2), :65)
@@ -192,6 +222,24 @@ class EtlSession:
             for handle in self.executors:
                 handle.wait_ready()
             self.master.wait_ready()
+            if self.block_service is not None:
+                from raydp_tpu.store import block_service as _bs
+
+                try:
+                    self.block_service.wait_ready()
+                    _bs.register_service(self.block_service._actor_id)
+                except Exception:
+                    # no service, no handoff: the head falls back to
+                    # executor ownership and lineage covers losses (the
+                    # PR 8 tier) — degraded, not broken, but say so
+                    obs.log.warning(
+                        "block service failed to start; executor death "
+                        "falls back to lineage recovery", exc_info=True,
+                    )
+                    obs.metrics.counter(
+                        "block_service.spawn_failures"
+                    ).inc()
+                    self.block_service = None
         obs.metrics.counter("etl.sessions_started").inc()
         self._next_executor_id = num_executors
 
@@ -246,6 +294,10 @@ class EtlSession:
         from raydp_tpu.store import object_store as _store
 
         _store.set_location_cache(self._planner.head_bypass)
+        # driver-side half of the block-service toggle (executors read the
+        # same conf from their configs dict): OFF keeps driver-context
+        # registrations un-flagged too, for strict A/B parity
+        _store.set_block_service(self._block_service_enabled)
         cluster.set_doorbell(_flag("cluster.doorbell"))
         from raydp_tpu.etl import tasks as _tasks
 
@@ -301,6 +353,10 @@ class EtlSession:
         _obs.metrics.counter("lineage.reexecuted_tasks")
         _obs.metrics.counter("lineage.recovered_blocks")
         _obs.metrics.counter("etl.task_retries")
+        _obs.metrics.counter("block_service.handoffs")
+        _obs.metrics.counter("etl.reown_failures")
+        _obs.metrics.counter("rpc.retries")
+        _obs.metrics.counter("rpc.deadline_exceeded")
         if self._dyn_enabled:
             self._planner.scale_hook = self._on_stage_width
             threading.Thread(
@@ -541,6 +597,24 @@ class EtlSession:
             )
         return len(self.executors)
 
+    def _service_owns_blocks(self) -> bool:
+        """True when the per-host block service is the live owner of record
+        — scale-in skips the reown sweep entirely (the departing executors
+        never owned their blocks). A DEAD service means recently written
+        blocks fell back to executor ownership (the head's handoff
+        fallback), so the reown runs as before."""
+        handle = self.block_service
+        if handle is None:
+            return False
+        from raydp_tpu.cluster.common import ActorState
+
+        try:
+            return handle.state() != ActorState.DEAD
+        except Exception:
+            # can't reach the head: assume the worst (executor-owned) and
+            # let the reown path try — its own failure is now counted
+            return False
+
     def kill_executors(
         self, count: int = 1, only_if_idle: bool = False, min_keep: int = 0
     ) -> int:
@@ -572,22 +646,35 @@ class EtlSession:
             # sync the planner BEFORE any kill: a stage submitted during the
             # (kill + DEAD-drain) window must not round-robin onto victims
             planner.executors = list(self.executors)
-        for handle in victims:
-            # graceful scale-in re-replicates ownership BEFORE the kill: the
-            # departing executor's blocks move to the session master (their
-            # segments survive the process; only owner-death GC would unlink
-            # them). Blocks the reown misses — racing writes, an older
-            # head — stay covered by lineage recovery: their entries still
-            # name the producing tasks, so a later read re-executes instead
-            # of failing (docs/fault_tolerance.md "scale-in").
-            try:
-                cluster.head_rpc(
-                    "object_reown_all",
-                    old_owner=handle._actor_id,
-                    new_owner=self.master._actor_id,
-                )
-            except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races actor death; reown is best-effort, lineage covers the rest)
-                pass  # older head / racing shutdown: lineage recovery covers
+        if victims and not self._service_owns_blocks():
+            # No live block service (conf off, or the service died): the
+            # victims own their blocks, so graceful scale-in re-replicates
+            # ownership BEFORE the kill — the departing executor's blocks
+            # move to the session master (their segments survive the
+            # process; only owner-death GC would unlink them). Blocks the
+            # reown misses — racing writes, an older head — stay covered by
+            # lineage recovery (docs/fault_tolerance.md "scale-in"). With a
+            # live service this whole sweep is skipped: the blocks were
+            # never executor-owned, and tests pin the zero-reown-RPC
+            # contract.
+            for handle in victims:
+                try:
+                    cluster.head_rpc(
+                        "object_reown_all",
+                        old_owner=handle._actor_id,
+                        new_owner=self.master._actor_id,
+                    )
+                except Exception:
+                    # best-effort stays valid (older head / racing shutdown:
+                    # lineage recovery covers) — but the signal must not be
+                    # invisible: a persistently failing reown means every
+                    # scale-in is silently betting on lineage
+                    from raydp_tpu import obs
+
+                    obs.metrics.counter("etl.reown_failures").inc()
+                    obs.instant(
+                        "etl.reown_failed", executor=handle._actor_id
+                    )
         for handle in victims:
             try:
                 handle.kill(no_restart=True)
@@ -638,6 +725,14 @@ class EtlSession:
         self._stopped = True
         self._dealloc_stop.set()
         killed = list(self.executors)
+        # the block service dies WITH the session (intentional kill): the
+        # ownership contract — non-transferred data dies at stop
+        # (test_ownership_dies_with_session) — must hold for service-owned
+        # blocks exactly as it did for executor-owned ones. Data meant to
+        # survive was transferred to the master before stop, as always.
+        if self.block_service is not None:
+            killed.append(self.block_service)
+            self.block_service = None
         # stale handles must not look like a live pool (Dataset._slice_block
         # and any late queries fall back to driver-local paths)
         self._planner.executors = []

@@ -1,0 +1,143 @@
+"""Per-host block service: blocks survive the executor that wrote them.
+
+The reference avoids re-computing shuffle output on executor loss with
+``RayExternalShuffleService`` (PAPER.md L3) — a per-node block server that
+owns shuffle blocks independent of executor lifetime. This module is that
+role for the native runtime: one :class:`BlockService` actor per shared-
+memory namespace (= per host; every virtual node on one machine shares
+/dev/shm), forked warm from the node's zygote like any light actor, whose
+actor id is the OWNER of record for completed ETL/shuffle blocks.
+
+The handoff is an ownership transfer of the existing segment — zero-copy
+and zero extra RPCs. An executor's block registration (the PR 3
+``batched_registration`` frame) carries a ``handoff`` flag; the head, which
+knows actor liveness authoritatively, records the namespace's live block
+service as the owner instead of the executor. Nothing moves: the segment
+stays exactly where the executor wrote it, readers keep mapping shm
+directly, and the registration reply tells the writer the effective owner
+so its location cache (and the metas it pushes to peers) stay truthful.
+
+What this buys (docs/fault_tolerance.md "Ownership tiers"):
+
+- executor SIGKILL no longer loses blocks — the owner of record is alive,
+  so nothing is unregistered, reads keep hitting shm, and lineage recovery
+  (PR 8) demotes from the common path to the fallback;
+- ``kill_executors`` scale-in skips the best-effort ``object_reown_all``
+  sweep entirely (the blocks were never executor-owned);
+- the lease-stamped head-bypass location cache never goes stale on
+  executor death (the cached owner is the service, which is still alive);
+- remote fetches get a first-class owner to talk to: the head advertises
+  a live service's TCP socket as ``service_addr`` in location records, and
+  the store's fetch path prefers it (with the jittered-backoff retry
+  ladder in ``object_store._fetch_chunk`` riding out service restarts).
+
+The service itself is deliberately STATELESS: segments live in /dev/shm
+and ownership lives at the head, so a crash-restart (same actor identity,
+``max_restarts``) loses nothing. An intentional kill (chaos, session stop)
+is real loss — the head's owner-death path tombstones and unlinks every
+service-owned block, and readers fall back to lineage re-execution.
+
+``store.block_service`` session conf (default ON); OFF restores the PR 8
+executor-owned behavior byte-for-byte (the A/B parity arm).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+BLOCK_SERVICE_SUFFIX = "_BLOCK_SERVICE"
+
+
+class BlockService:
+    """The per-host block-server actor. Owns completed blocks in the head's
+    metadata table and serves their bytes to remote readers; holds no block
+    state of its own (see module docstring — restart must be free)."""
+
+    def __init__(self, app_name: str = ""):
+        self.app_name = app_name
+        import threading
+
+        from raydp_tpu.sanitize import named_lock
+
+        self._lock = named_lock("store.block_service", threading.Lock())
+        self._stats = {"fetches": 0, "bytes_served": 0}  # guarded-by: self._lock
+
+    def ping(self) -> str:
+        return "pong"
+
+    def block_fetch(self, shm_name: str, offset: int = 0, length: int = -1) -> bytes:
+        """Serve a local block's bytes (either tier: shm segment or spill
+        file) to a remote reader — the same primitive the head and node
+        agents expose, now answered by the blocks' owner of record."""
+        from raydp_tpu import obs
+        from raydp_tpu.cluster.common import serve_block_bytes
+
+        with obs.span("block_service.fetch", shm_name=shm_name):
+            data = serve_block_bytes(shm_name, offset, length)
+        obs.metrics.counter("block_service.fetches").inc()
+        obs.metrics.counter("block_service.bytes_served").inc(len(data))
+        with self._lock:
+            self._stats["fetches"] += 1
+            self._stats["bytes_served"] += len(data)
+        from raydp_tpu.obs import flush_throttled
+
+        flush_throttled(2.0)
+        return data
+
+    def stats(self) -> dict:
+        with self._lock:
+            return dict(self._stats)
+
+
+def service_block_fetch(
+    addr: str, shm_name: str, offset: int, length: int,
+    timeout: float = 300.0,
+) -> bytes:
+    """One ranged ``block_fetch`` against a BlockService ACTOR socket.
+    Actors speak the 4-tuple method frame (worker.py), not the head/agent
+    2-tuple op frame — this is the store's client for ``service_addr``
+    location records."""
+    from raydp_tpu.cluster.common import (
+        connect,
+        recv_frame,
+        send_frame,
+        traced_request,
+    )
+
+    with connect(addr, timeout) as sock:
+        send_frame(
+            sock,
+            traced_request(
+                ("block_fetch", (shm_name, offset, length), {}, False)
+            ),
+        )
+        status, value = recv_frame(sock)
+    if status == "ok":
+        return value
+    raise value
+
+
+def service_for_namespace(shm_ns: str = "") -> Optional[str]:
+    """The actor id of the block service registered for a shared-memory
+    namespace (None when that host runs without one — registrations there
+    keep executor ownership and rely on lineage, the PR 8 behavior)."""
+    from raydp_tpu.cluster import api as cluster_api
+
+    return cluster_api.head_rpc("block_service_lookup", shm_ns=shm_ns)
+
+
+def register_service(actor_id: str) -> str:
+    """Record a spawned BlockService actor as its node namespace's owner of
+    record at the head; returns the namespace it now serves."""
+    from raydp_tpu.cluster import api as cluster_api
+
+    return cluster_api.head_rpc("block_service_register", actor_id=actor_id)
+
+
+def deregister_service(actor_id: str) -> bool:
+    """Drop a service from the head's owner-kind table WITHOUT killing it:
+    registrations fall back to executor ownership (the A/B toggle the
+    bench's two-tier recovery probe flips mid-session)."""
+    from raydp_tpu.cluster import api as cluster_api
+
+    return cluster_api.head_rpc("block_service_unregister", actor_id=actor_id)

@@ -89,6 +89,53 @@ def set_location_cache(enabled: bool) -> None:
             _location_cache.clear()
 
 
+# ---------------------------------------------------------------------------
+# block-service handoff (store/block_service.py, docs/fault_tolerance.md)
+#
+# With ``store.block_service`` on (session default), an ACTOR's block
+# registrations are flagged for handoff: the head records the namespace's
+# live per-host block service as the owner instead of this executor — an
+# ownership transfer of the existing segment, zero-copy, riding the same
+# (batched) registration frame. The reply names the effective owner so the
+# writer's cached location (and the metas it pushes with task results)
+# carry the service, keeping the head-bypass cache truthful across
+# executor death.
+#
+# The MODULE default is off: only processes the ETL plane configures —
+# executors (via their configs dict) and the session driver — participate.
+# SPMD rank actors, holder actors, and standalone store users keep
+# self-ownership exactly as before.
+# ---------------------------------------------------------------------------
+
+_block_service_on = False
+
+
+def set_block_service(enabled: bool) -> None:
+    """Session-conf toggle (``store.block_service``): off = executors own
+    their blocks, the PR 8 behavior (lineage recovers on executor death) —
+    the A/B parity arm."""
+    global _block_service_on
+    _block_service_on = bool(enabled)
+
+
+def block_service_enabled() -> bool:
+    return _block_service_on
+
+
+def _adopt_owner(object_id: str, owner: str) -> None:
+    """The head reassigned a handoff registration to the block service:
+    patch this process's cached location so reads (and the pushed
+    ReadSpec.metas built from ``local_meta``) name the LIVE owner, not the
+    executor that happened to write the bytes."""
+    from raydp_tpu.obs import metrics
+
+    metrics.counter("block_service.handoffs").inc()
+    with _location_lock:
+        entry = _location_cache.get(object_id)
+        if entry is not None:
+            entry[0]["owner"] = owner
+
+
 def cache_location(
     object_id: str, meta: dict, stamp: Optional[float] = None,
     lease_s: Optional[float] = None,
@@ -430,13 +477,23 @@ def _register(ref: ObjectRef, owner: Optional[str], shm_name: Optional[str] = No
     # own reads (and the compiled-plan dispatches that push it to peers)
     # never ask the head where the block lives
     cache_location(ref.object_id, entry)
+    wire = entry
+    if _block_service_on and ctx is not None and owner is None:
+        # actor-produced block with default self-ownership: flag it for the
+        # per-host block-service handoff. The HEAD decides (it knows the
+        # service's liveness) and the reply names the effective owner; an
+        # explicit owner (ObjectHolder, recovery rebinds with a pinned
+        # target) is never second-guessed.
+        wire = dict(entry, handoff=True)
     staged = getattr(_register_batch_tls, "stack", None)
     if staged:
         # a batched_registration() scope is active on this thread: stage the
         # entry; ONE object_put_batch frame ships everything at scope exit
-        staged[-1].append(entry)
+        staged[-1].append(wire)
         return
-    cluster_api.head_rpc("object_put", **entry)
+    effective = cluster_api.head_rpc("object_put", **wire)
+    if isinstance(effective, str) and effective != entry["owner"]:
+        _adopt_owner(ref.object_id, effective)
 
 
 # ---------------------------------------------------------------------------
@@ -452,18 +509,31 @@ def _flush_register_batch(entries: List[dict]) -> None:
     if not entries:
         return
     if len(entries) == 1:
-        cluster_api.head_rpc("object_put", **entries[0])
+        effective = cluster_api.head_rpc("object_put", **entries[0])
+        if isinstance(effective, str) and effective != entries[0]["owner"]:
+            _adopt_owner(entries[0]["object_id"], effective)
         return
     from raydp_tpu.obs import metrics
 
     try:
-        cluster_api.head_rpc("object_put_batch", entries=entries)
+        reassigned = cluster_api.head_rpc("object_put_batch", entries=entries)
         metrics.counter("store.register_batches").inc()
+        if isinstance(reassigned, dict):
+            # block-service handoff: the head named the effective owner for
+            # every reassigned entry — correct the cache in the same frame
+            for object_id, owner in reassigned.items():
+                _adopt_owner(object_id, owner)
     except ClusterError as exc:
         if "unknown head method" not in str(exc):
             raise
         for entry in entries:
-            cluster_api.head_rpc("object_put", **entry)
+            # an older head has no batch op — and no handoff kwarg (nor a
+            # service to adopt): strip the flag so the compat path degrades
+            # to executor ownership instead of a TypeError
+            cluster_api.head_rpc(
+                "object_put",
+                **{k: v for k, v in entry.items() if k != "handoff"},
+            )
 
 
 def _discard_staged(entries: List[dict]) -> None:
@@ -959,27 +1029,114 @@ class _FileBuffer:
             pass
 
 
+# RPC robustness for the block-fetch path (docs/fault_tolerance.md "RPC
+# retry ladder"): a reader hitting a RESTARTING block service (or a briefly
+# unreachable agent) backs off with jitter and retries under a per-call
+# deadline instead of surfacing a raw ConnectionRefusedError — and past the
+# deadline it raises a lost-block-shaped error so the caller degrades to
+# lineage recovery. Counted: ``rpc.retries`` / ``rpc.deadline_exceeded``.
+FETCH_DEADLINE_ENV = "RAYDP_TPU_FETCH_DEADLINE_S"
+_FETCH_BACKOFF_BASE_S = 0.05
+_FETCH_BACKOFF_CAP_S = 2.0
+
+
+def _fetch_deadline_s() -> float:
+    try:
+        return float(os.environ.get(FETCH_DEADLINE_ENV, "") or 30.0)
+    except ValueError:
+        return 30.0
+
+
+def _fetch_chunk(
+    ref: ObjectRef, meta: dict, offset: int, length: int, deadline: float
+) -> bytes:
+    """One ranged chunk pull with the jittered-backoff retry ladder.
+    Prefers the block service's own socket (``service_addr`` — the
+    first-class owner) over the node's agent/head ``fetch_addr``; every few
+    failed attempts the location is re-resolved through the head, so a
+    service that restarted onto a fresh socket is found mid-ladder (and an
+    owner the head reports dead propagates OwnerDiedError → lineage)."""
+    import random
+    import socket as _socket
+    import time as _time
+
+    from raydp_tpu.obs import metrics
+
+    request = {"shm_name": meta["shm_name"], "offset": offset, "length": length}
+    attempt = 0
+    while True:
+        service_addr = meta.get("service_addr")
+        try:
+            if service_addr:
+                from raydp_tpu.store.block_service import service_block_fetch
+
+                return service_block_fetch(
+                    service_addr, meta["shm_name"], offset, length
+                )
+            return rpc(meta["fetch_addr"], ("block_fetch", request), timeout=300)
+        except (ConnectionError, EOFError, _socket.timeout, OSError) as exc:
+            if isinstance(exc, FileNotFoundError):
+                # a remote "segment/file is gone" is NOT transient: the
+                # bytes are gone while the head meta survives, and retrying
+                # would stall the reader for the whole deadline against the
+                # same answer — surface it now (the caller's stale-location
+                # retry / lineage fallback is the right escalation)
+                raise
+            metrics.counter("rpc.retries").inc()
+            remaining = deadline - _time.monotonic()
+            if remaining <= 0:
+                metrics.counter("rpc.deadline_exceeded").inc()
+                err = ClusterError(
+                    f"object {ref.object_id} fetch from "
+                    f"{service_addr or meta.get('fetch_addr')} kept failing "
+                    f"past the {_fetch_deadline_s():.0f}s deadline ({exc})"
+                )
+                # lost-block-shaped: the reader's lineage fallback takes over
+                err.object_ids = [ref.object_id]
+                raise err from exc
+            delay = min(
+                _FETCH_BACKOFF_CAP_S, _FETCH_BACKOFF_BASE_S * (2 ** attempt)
+            )
+            # jitter: a herd of readers bounced off one restarting service
+            # must not retry in lockstep
+            delay *= 0.5 + random.random()
+            _time.sleep(min(delay, max(0.0, remaining)))
+            attempt += 1
+            if attempt % 3 == 0:
+                # authoritative re-resolution: a restarted service binds a
+                # FRESH socket; the head's live view carries it (and raises
+                # OwnerDiedError / not-found when the block is really gone,
+                # which must propagate — that IS the lineage trigger).
+                # Updated IN PLACE: _remote_fetch shares one meta dict
+                # across chunks, so later chunks of a large fetch start at
+                # the re-resolved address instead of re-paying the ladder.
+                fresh = _lookup(ref, fresh=True)
+                meta.clear()
+                meta.update(fresh)
+                request["shm_name"] = meta["shm_name"]
+
+
 def _remote_fetch(ref: ObjectRef, meta: dict, offset: int, length: int) -> bytes:
     """Ranged network pull of ``[offset, offset+length)`` from the owning
     node's block server (chunked: stays under the wire frame cap for
     arbitrarily large reads and bounds per-chunk copies). The server's
     ``block_fetch`` is range-native, so a reducer pulling its slice of an
-    indexed shuffle block moves only that slice's bytes over the network."""
+    indexed shuffle block moves only that slice's bytes over the network.
+    Each chunk rides the retry ladder (``_fetch_chunk``): a restarting
+    block service degrades to backoff-and-retry, then to lineage recovery
+    at the deadline, never to a raw ConnectionRefusedError."""
+    import time as _time
+
     chunk = 64 << 20
     parts = []
     pulled = 0
+    # one shared copy: a mid-ladder re-resolution in _fetch_chunk updates
+    # it in place, so every later chunk starts at the live address
+    meta = dict(meta)
+    deadline = _time.monotonic() + _fetch_deadline_s()
     while pulled < length:
-        part = rpc(
-            meta["fetch_addr"],
-            (
-                "block_fetch",
-                {
-                    "shm_name": meta["shm_name"],
-                    "offset": offset + pulled,
-                    "length": min(chunk, length - pulled),
-                },
-            ),
-            timeout=300,
+        part = _fetch_chunk(
+            ref, meta, offset + pulled, min(chunk, length - pulled), deadline
         )
         if not part:
             break

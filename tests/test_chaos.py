@@ -24,9 +24,15 @@ from tools import chaos
 
 @pytest.fixture()
 def session():
+    # the LINEAGE arm: store.block_service=false keeps blocks
+    # executor-owned, so an executor SIGKILL is real loss and these
+    # white-box recovery cases still exercise the fallback tier. With the
+    # per-host block service ON (the default since ISSUE 11), executor
+    # death loses zero blocks — that tier is pinned by
+    # tests/test_block_service.py.
     s = raydp_tpu.init_etl(
         "test-chaos", num_executors=2, executor_cores=1,
-        executor_memory="300M",
+        executor_memory="300M", configs=dict(chaos.LINEAGE_ARM),
     )
     yield s
     raydp_tpu.stop_etl()
@@ -56,6 +62,23 @@ def test_chaos_mid_compiled_dispatch_kill():
 def test_chaos_mid_streaming_fit_kill_byte_identical():
     report = chaos.scenario_mid_fit(rows=1536)
     assert report["byte_identical"], report
+    assert report["reexecuted_tasks"] >= 1, report
+
+
+def test_chaos_executor_kill_with_service_zero_reexecution():
+    """The block-service tier (ISSUE 11): executor SIGKILL mid-shuffle
+    with store.block_service ON completes byte-identical with ZERO
+    lineage re-execution — executor death loses no blocks."""
+    report = chaos.scenario_executor_kill_with_service(rows=40_000)
+    assert report["ok"], report
+    assert report["reexecuted_tasks"] == 0, report
+
+
+def test_chaos_service_kill_recovers_via_lineage():
+    """The fallback tier: killing the block SERVICE is real loss and
+    lineage recovery restores byte-identical results."""
+    report = chaos.scenario_service_kill_lineage_fallback(rows=20_000)
+    assert report["ok"], report
     assert report["reexecuted_tasks"] >= 1, report
 
 

@@ -133,8 +133,11 @@ def test_scale_out_rides_warm_zygote_fork(session):
 
 
 def test_scale_in_block_holder_loses_no_data(session):
-    """Graceful scale-in of a block-HOLDING executor: ownership re-owns to
-    the session master first, so the dataset survives the kill."""
+    """Graceful scale-in of a block-PRODUCING executor loses no data.
+    Since ISSUE 11 the per-host block service owns completed blocks, so
+    scale-in needs no reown sweep at all (zero object_reown_all RPCs —
+    the pre-service reown-to-master path is pinned by the conf-off arm in
+    tests/test_block_service.py)."""
     from raydp_tpu import obs
     from raydp_tpu.store import object_store as store
 
@@ -142,13 +145,20 @@ def test_scale_in_block_holder_loses_no_data(session):
         "w", F.col("id") * 2
     )
     ds = dataframe_to_dataset(df)
-    owners = {store.owner_of(b) for b in ds.blocks}
-    tail = session.executors[-1]._actor_id
-    assert tail in owners  # the victim really holds blocks
+    # the blocks are SERVICE-owned from birth — no executor ever owned them
+    service_id = session.block_service._actor_id
+    assert {store.owner_of(b) for b in ds.blocks} == {service_id}
     before = obs.metrics.counter("cluster.scale_in").value
+    reown_before = obs.metrics.counter(
+        "rpc.client.calls.object_reown_all"
+    ).value
     session.kill_executors(1, min_keep=1)
     assert obs.metrics.counter("cluster.scale_in").value == before + 1
-    # blocks were re-owned, not lost: no lineage re-execution needed
+    # no reown sweep ran, and nothing was lost: no lineage re-execution
+    assert (
+        obs.metrics.counter("rpc.client.calls.object_reown_all").value
+        == reown_before
+    )
     assert ds.to_arrow().num_rows == 4_000
     # and queries over them keep working on the shrunken pool
     from raydp_tpu.exchange import dataset_to_dataframe

@@ -15,16 +15,31 @@ is REAL, unlike a restartable crash whose shm survives) and asserts that
   fd counts ride the report as advisory (the sanitize design's own stance
   on raw fd deltas).
 
+The per-host block service (store/block_service.py) splits the scenarios
+into two ownership tiers:
+
+- the three lineage scenarios run with ``store.block_service=false`` (the
+  PR 8 arm — executor-owned blocks, loss is real, recovery re-executes);
+- ``executor_kill_with_service`` kills an executor mid-shuffle with the
+  service ON and gates ``lineage.reexecuted_tasks == 0`` — executor death
+  must lose ZERO blocks;
+- ``service_kill_lineage_fallback`` SIGKILLs the block service itself
+  mid-query: real loss of every service-owned block, recovered via lineage
+  byte-identically.
+
 Usage::
 
     RAYDP_TPU_SANITIZE=donation,lockdep,leaks-strict \
-        python -m tools.chaos --quick --json chaos_report.json
+        python -m tools.chaos --quick --seed 7 --json chaos_report.json
 
-``--quick`` runs the CI slice (one mid-shuffle kill + one mid-fit kill);
-without it the full scenario list runs (adds the compiled-dispatch kill and
-the elasticity round-trip). Exit code is non-zero when any query went
-unrecovered or any sanitizer finding surfaced. The same scenario bodies are
-reused by ``tests/test_chaos.py`` via the importable helpers below.
+``--quick`` runs the CI slice (mid-shuffle + mid-fit lineage kills, plus
+both block-service tiers); without it the full scenario list runs (adds
+the compiled-dispatch kill and the elasticity round-trip). ``--seed``
+makes victim/timing selection deterministic (unseeded runs keep the fixed
+legacy choices). Exit code is non-zero when any query went unrecovered or
+any sanitizer finding surfaced. The same scenario bodies are reused by
+``tests/test_chaos.py`` / ``tests/test_block_service.py`` via the
+importable helpers below.
 """
 # raydp-lint: disable-file=print-diagnostics (standalone CI tool: its stdout IS the report, there is no obs role to tag)
 
@@ -33,6 +48,7 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import random
 import sys
 import threading
 import time
@@ -46,6 +62,31 @@ if REPO not in sys.path:
 # ---------------------------------------------------------------------------
 # primitives (importable by tests/test_chaos.py)
 # ---------------------------------------------------------------------------
+
+# --seed: deterministic victim/timing selection. Unseeded (None) keeps the
+# legacy fixed choices (index 0, exact delays) so existing runs reproduce.
+RNG: Optional[random.Random] = None
+
+
+def set_seed(seed: Optional[int]) -> None:
+    global RNG
+    RNG = None if seed is None else random.Random(seed)
+
+
+def pick_index(n: int) -> int:
+    """Seeded victim index over an n-executor pool (0 when unseeded)."""
+    if RNG is None or n <= 1:
+        return 0
+    return RNG.randrange(n)
+
+
+def jittered(delay_s: float) -> float:
+    """Seeded timing jitter for delayed kills (exact delay when unseeded):
+    the kill lands in a DIFFERENT query window per seed, so repeated seeded
+    runs sweep the race surface deterministically."""
+    if RNG is None:
+        return delay_s
+    return delay_s * (0.5 + RNG.random())
 
 
 def kill_executor(session, handle=None, index: int = 0):
@@ -62,20 +103,41 @@ def kill_executor(session, handle=None, index: int = 0):
     return victim
 
 
-def delayed_kill(session, delay_s: float, index: int = 0) -> threading.Thread:
-    """Arm a timer thread that SIGKILLs an executor mid-whatever-is-running.
-    Join it after the workload completes."""
+def delayed_kill(
+    session, delay_s: float, index: Optional[int] = 0
+) -> threading.Thread:
+    """Arm a timer thread that SIGKILLs an executor mid-whatever-is-running
+    (``index=None`` = seeded victim pick at fire time; the delay rides the
+    seeded jitter either way). Join it after the workload completes."""
 
     def _fire():
-        time.sleep(delay_s)
+        time.sleep(jittered(delay_s))
         try:
-            kill_executor(session, index=index)
+            victim = index
+            if victim is None:
+                victim = pick_index(len(session.executors))
+            kill_executor(session, index=victim)
         except Exception:  # raydp-lint: disable=swallowed-exceptions (chaos timer: the victim may already be gone, racing scenario teardown)
             pass
 
     thread = threading.Thread(target=_fire, name="chaos-killer", daemon=True)
     thread.start()
     return thread
+
+
+def kill_service(session):
+    """SIGKILL the session's block service with NO restart — the real-loss
+    primitive of the SERVICE tier: the head tombstones and unlinks every
+    service-owned block, so surviving references must come back through
+    lineage re-execution. Returns the (dead) service handle."""
+    from raydp_tpu.store import object_store as store
+
+    victim = session.block_service
+    if victim is None:
+        raise RuntimeError("session has no block service (conf off?)")
+    victim.kill(no_restart=True)
+    store.note_owner_dead(victim._actor_id)
+    return victim
 
 
 def block_owner_executor(session, ds):
@@ -125,13 +187,20 @@ def sanitizer_report() -> dict:
 # ---------------------------------------------------------------------------
 
 
-def _fresh_session(name: str, executors: int = 2):
+def _fresh_session(name: str, executors: int = 2, configs: Optional[dict] = None):
     import raydp_tpu
 
     return raydp_tpu.init_etl(
         name, num_executors=executors, executor_cores=1,
-        executor_memory="300M",
+        executor_memory="300M", configs=configs,
     )
+
+
+# the PR 8 arm: executor-OWNED blocks, so an executor SIGKILL is real loss
+# and lineage recovery is the only way back. The three lineage scenarios
+# pin this conf so the fallback tier stays proven now that the block
+# service (default ON) makes executor death lose nothing on the common path.
+LINEAGE_ARM = {"store.block_service": "false"}
 
 
 def scenario_mid_shuffle(rows: int = 120_000) -> dict:
@@ -142,7 +211,7 @@ def scenario_mid_shuffle(rows: int = 120_000) -> dict:
     from raydp_tpu.etl import functions as F
     from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
 
-    session = _fresh_session("chaos-shuffle")
+    session = _fresh_session("chaos-shuffle", configs=LINEAGE_ARM)
     try:
         # deterministic half: a shuffle whose SOURCE blocks are executor-
         # owned loses real data when the owner dies — the map round must
@@ -161,8 +230,9 @@ def scenario_mid_shuffle(rows: int = 120_000) -> dict:
         session.request_total_executors(2)  # restore the pool
 
         # racing half: a timed kill lands wherever it lands (map dispatch,
-        # between rounds, reduce read) — every window must hold
-        killer = delayed_kill(session, 0.05, index=0)
+        # between rounds, reduce read) — every window must hold; seeded
+        # runs sweep the window deterministically (victim + delay jitter)
+        killer = delayed_kill(session, 0.05, index=None)
         chaos2 = df.group_by("k").count().sort("k").collect()
         killer.join()
         session.request_total_executors(2)
@@ -195,7 +265,7 @@ def scenario_mid_compiled(rows: int = 50_000) -> dict:
     from raydp_tpu.etl import functions as F
     from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
 
-    session = _fresh_session("chaos-compiled")
+    session = _fresh_session("chaos-compiled", configs=LINEAGE_ARM)
     try:
         src = session.range(rows, num_partitions=4).with_column(
             "x", F.col("id") * 3
@@ -273,7 +343,7 @@ def scenario_mid_fit(rows: int = 2048) -> dict:
             "raw": [np.asarray(leaf).copy() for leaf in leaves],
         }
 
-    session = _fresh_session("chaos-fit")
+    session = _fresh_session("chaos-fit", configs=LINEAGE_ARM)
     try:
         rng = np.random.default_rng(3)
         pdf = pd.DataFrame(
@@ -321,9 +391,11 @@ def scenario_elasticity() -> dict:
         t0 = time.perf_counter()
         session.request_total_executors(2)
         scale_out_s = time.perf_counter() - t0
-        # materialize AFTER the scale-out so blocks land on both executors;
+        # materialize AFTER the scale-out so both executors produce blocks;
         # kill_executors takes victims from the pool's tail — the new
-        # executor — which then holds blocks (the scale-in-with-data case)
+        # executor. With the block service ON (default here) the data
+        # survives because the victims never owned it (zero reown RPCs);
+        # the conf-off reown-to-master arm is pinned in test_block_service.
         df = session.range(20_000, num_partitions=4).with_column(
             "v", F.col("id") + 1
         )
@@ -343,11 +415,140 @@ def scenario_elasticity() -> dict:
         raydp_tpu.stop_etl()
 
 
-QUICK = (scenario_mid_shuffle, scenario_mid_fit)
+def scenario_executor_kill_with_service(rows: int = 120_000) -> dict:
+    """The block-service tier's headline contract: with
+    ``store.block_service`` ON (the default), an executor SIGKILL
+    mid-shuffle loses ZERO blocks — the per-host service owns every
+    completed block, reads keep hitting shm, and the query completes
+    byte-identical with ``lineage.reexecuted_tasks == 0`` (in-flight tasks
+    on the victim re-dispatch via the ordinary retry ladder, which is not
+    lineage re-execution). Both halves of the mid-shuffle scenario run:
+    a deterministic kill between queries and a timed kill mid-query."""
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+
+    session = _fresh_session("chaos-exec-svc")
+    try:
+        src = session.range(rows, num_partitions=8).with_column(
+            "k", F.col("id") % 13
+        )
+        ds = dataframe_to_dataset(src)
+        # ownership sanity: the blocks belong to the SERVICE, not any
+        # executor — otherwise this scenario would silently test the
+        # lineage arm (block_owner_executor finds executor-owned blocks)
+        service_owned = block_owner_executor(session, ds) is None
+        df = dataset_to_dataframe(session, ds)
+        clean = df.group_by("k").count().sort("k").collect()
+        before = lineage_counters()
+
+        kill_executor(session, index=pick_index(len(session.executors)))
+        time.sleep(0.3)
+        chaos = df.group_by("k").count().sort("k").collect()
+        session.request_total_executors(2)
+
+        killer = delayed_kill(session, 0.05, index=None)
+        chaos2 = df.group_by("k").count().sort("k").collect()
+        killer.join()
+        session.request_total_executors(2)
+
+        after = lineage_counters()
+        reexecuted = after["reexecuted_tasks"] - before["reexecuted_tasks"]
+        identical = chaos == clean and chaos2 == clean
+        return {
+            "name": "executor_kill_with_service",
+            "ok": bool(identical and service_owned and reexecuted == 0),
+            "byte_identical": bool(identical),
+            "service_owned": bool(service_owned),
+            # THE gate: executor death must cost zero re-executed tasks
+            "reexecuted_tasks": reexecuted,
+            "reexecution_bound": 0,
+            "within_bound": reexecuted == 0,
+        }
+    finally:
+        raydp_tpu.stop_etl()
+
+
+def scenario_service_kill_lineage_fallback(rows: int = 60_000) -> dict:
+    """The fallback tier: SIGKILL the block SERVICE itself (no restart —
+    the head tombstones and unlinks every service-owned block, real loss)
+    both between queries and mid-query, and assert lineage re-execution
+    brings the results back byte-identical under the strict sanitizers."""
+    import raydp_tpu
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+
+    session = _fresh_session("chaos-svc-kill")
+    try:
+        src = session.range(rows, num_partitions=8).with_column(
+            "k", F.col("id") % 13
+        )
+        ds = dataframe_to_dataset(src)
+        df = dataset_to_dataframe(session, ds)
+        clean = df.group_by("k").count().sort("k").collect()
+        before = lineage_counters()
+
+        # deterministic half: the service (and all its blocks) die between
+        # queries — the next query's reads surface OwnerDiedError and
+        # lineage re-executes the producing tasks on the live executors
+        kill_service(session)
+        time.sleep(0.3)
+        chaos = df.group_by("k").count().sort("k").collect()
+
+        # racing half: a fresh session (the dead service released its
+        # name), service killed WHILE a query is in flight
+        raydp_tpu.stop_etl()
+        session = _fresh_session("chaos-svc-kill-2")
+        src2 = session.range(rows, num_partitions=8).with_column(
+            "k", F.col("id") % 13
+        )
+        ds2 = dataframe_to_dataset(src2)
+        df2 = dataset_to_dataframe(session, ds2)
+        clean2 = df2.group_by("k").count().sort("k").collect()
+
+        def _fire():
+            time.sleep(jittered(0.05))
+            try:
+                kill_service(session)
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (chaos timer: racing scenario teardown)
+                pass
+
+        killer = threading.Thread(target=_fire, daemon=True)
+        killer.start()
+        chaos2 = df2.group_by("k").count().sort("k").collect()
+        killer.join()
+
+        after = lineage_counters()
+        reexecuted = after["reexecuted_tasks"] - before["reexecuted_tasks"]
+        identical = chaos == clean and chaos2 == clean2
+        # bound: the deterministic half re-executes ≤ one map round + one
+        # source level (8 × 2); the racing half may or may not lose blocks
+        # depending on where the kill lands — same allowance
+        bound = 32
+        return {
+            "name": "service_kill_lineage_fallback",
+            "ok": bool(identical and reexecuted >= 1),
+            "byte_identical": bool(identical),
+            "reexecuted_tasks": reexecuted,
+            "reexecution_bound": bound,
+            "within_bound": reexecuted <= bound,
+        }
+    finally:
+        raydp_tpu.stop_etl()
+
+
+QUICK = (
+    scenario_mid_shuffle,
+    scenario_mid_fit,
+    scenario_executor_kill_with_service,
+    scenario_service_kill_lineage_fallback,
+)
 FULL = (
     scenario_mid_shuffle,
     scenario_mid_compiled,
     scenario_mid_fit,
+    scenario_executor_kill_with_service,
+    scenario_service_kill_lineage_fallback,
     scenario_elasticity,
 )
 
@@ -409,15 +610,21 @@ def run(scenarios) -> dict:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--quick", action="store_true",
-                        help="CI slice: one mid-shuffle + one mid-fit kill")
+                        help="CI slice: mid-shuffle + mid-fit lineage kills "
+                             "plus both block-service tiers")
+    parser.add_argument("--seed", type=int, default=None,
+                        help="deterministic victim/timing selection "
+                             "(unseeded keeps the fixed legacy choices)")
     parser.add_argument("--json", default="chaos_report.json",
                         help="report artifact path")
     args = parser.parse_args(argv)
+    set_seed(args.seed)
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault(
         "RAYDP_TPU_SANITIZE", "donation,lockdep,leaks-strict"
     )
     report = run(QUICK if args.quick else FULL)
+    report["seed"] = args.seed
     with open(args.json, "w") as f:
         json.dump(report, f, indent=2, default=str)
     print(json.dumps({k: v for k, v in report.items() if k != "scenarios"}))

@@ -6,7 +6,7 @@ BENCH_* env vars), writes an artifact JSON holding the headline ETL numbers
 plus the full ``etl_breakdown`` and per-exchange shuffle stats, and FAILS
 when:
 
-- ``etl_query_s`` regresses more than 25% over the committed BENCH_r07
+- ``etl_query_s`` regresses more than 25% over the committed BENCH_r08
   snapshot's value (the CI slice runs ~10x fewer rows than the snapshot's
   run, so this is a smoke gate for gross regressions — a structural
   slowdown in the data plane, not a ±10% noise detector);
@@ -29,11 +29,14 @@ when:
   against the snapshot's 10x-bigger run, while "hybrid regressed below
   the uncached path" (the r06 symptom this gate exists for) shows up in
   the quotient at any scale;
-- the recovery probe failed (``recovery_probe.ok`` false): a query with
-  one injected executor SIGKILL must come back correct through lineage
-  recovery with ≥1 re-executed task. ``recovery_overhead`` itself is
+- the recovery probe failed (``recovery_probe.ok`` false): BOTH ownership
+  tiers must hold — with the block service ON an injected executor SIGKILL
+  must come back correct with ZERO re-executed tasks (executor death loses
+  no blocks), and with the service deregistered the same kill must recover
+  through lineage with ≥1 re-executed task. ``recovery_overhead`` itself is
   reported, not gated — but the etl_query_s/burst gates above hold the
-  CLEAN path to <25% regression, i.e. lineage bookkeeping must be ~free.
+  CLEAN path to <25% regression vs the r08 snapshot, i.e. the block-service
+  handoff (like the lineage bookkeeping before it) must be ~free.
 
 Usage: ``python tools/perf_smoke.py [artifact.json]``
 """
@@ -53,7 +56,7 @@ CONSUMER_IDLE_BUDGET_S = 0.2  # absolute: the streaming consumer stays fed
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
-SNAPSHOT = "BENCH_r07.json"
+SNAPSHOT = "BENCH_r08.json"
 
 
 def _snapshot_value(key: str) -> float | None:
@@ -180,9 +183,10 @@ def main() -> int:
     recovery = artifact["recovery_probe"]
     if recovery and not recovery.get("ok"):
         failures.append(
-            f"recovery probe failed: {recovery} (a query with one injected "
-            "executor SIGKILL must recover byte-correct via lineage with "
-            "≥1 re-executed task)"
+            f"recovery probe failed: {recovery} (service ON: an injected "
+            "executor SIGKILL must be loss-free with 0 re-executed tasks; "
+            "service OFF: the same kill must recover byte-correct via "
+            "lineage with ≥1 re-executed task)"
         )
     for entry in artifact["shuffle_probe"].get("shuffle", []):
         if entry.get("indexed") and entry["blocks"] > entry["map_tasks"]:
