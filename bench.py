@@ -345,6 +345,137 @@ def recovery_probe(session, df) -> dict:
     }
 
 
+def serving_probe() -> dict:
+    """Closed-loop serving load generator (raydp_tpu.serve, docs/serving.md)
+    plus a kill-during-load recovery probe.
+
+    A tiny model checkpoint is published directly (init + save — the probe
+    measures SERVING, training throughput has its own sections), deployed on
+    two replicas, and driven by N closed-loop clients (each waits for its
+    response before sending the next request) for a fixed wall-clock window.
+    Reports p50/p99 request latency, sustained requests/sec, and SLO
+    attainment at a fixed p99 SLO (``BENCH_SERVE_SLO_MS``, default 250ms —
+    generous on a 2-core CPU box; the gate exists to catch structural
+    regressions like a compile or a fresh connect on the request path).
+
+    The recovery probe then replays a FIXED request list twice — clean, and
+    with a replica SIGKILLed mid-stream — under a single batch bucket
+    (deterministic shapes), gating zero dropped requests and byte-identical
+    responses, the same contract the chaos scenario pins in CI."""
+    import tempfile
+    import threading
+
+    import jax
+
+    from raydp_tpu import serve
+    from raydp_tpu.models import MLPRegressor
+
+    slo_ms = float(os.environ.get("BENCH_SERVE_SLO_MS", 250.0))
+    duration_s = float(os.environ.get("BENCH_SERVE_SECONDS", 3.0))
+    n_clients = int(os.environ.get("BENCH_SERVE_CLIENTS", 4))
+
+    model = MLPRegressor(hidden=(32, 16))
+    rng = np.random.default_rng(11)
+    x = rng.random((1024, len(FEATURES))).astype(np.float32)
+    ckpt_dir = tempfile.mkdtemp(prefix="bench-serve-ckpt-")
+    # publish weights through the same estimator checkpoint channel the
+    # replicas load from
+    from raydp_tpu.estimator import JaxEstimator
+
+    est = JaxEstimator(
+        model=model, feature_columns=FEATURES, checkpoint_dir=ckpt_dir
+    )
+    params = model.init(jax.random.PRNGKey(0), x[:1])
+    est._save_checkpoint(params, 0, {})
+
+    dep = None
+    try:
+        t_spinup = time.perf_counter()
+        dep = serve.deploy(
+            est, replicas=2, example=x[0],
+            conf={"serve.max_batch_size": 16,
+                  "serve.autoscale.tick_s": 0.1},
+        )
+        spinup_s = time.perf_counter() - t_spinup
+
+        # -- closed-loop load ------------------------------------------
+        latencies: list = []
+        lat_lock = threading.Lock()
+        stop_at = time.perf_counter() + duration_s
+
+        def client(seed: int):
+            local = []
+            i = seed
+            while time.perf_counter() < stop_at:
+                t0 = time.perf_counter()
+                dep.predict(x[i % 1024 : i % 1024 + 1])
+                local.append(time.perf_counter() - t0)
+                i += 1
+            with lat_lock:
+                latencies.extend(local)
+
+        threads = [
+            threading.Thread(target=client, args=(k * 31,))
+            for k in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+        latencies.sort()
+        n = len(latencies)
+        p50_ms = latencies[n // 2] * 1000 if n else None
+        p99_ms = (
+            latencies[min(n - 1, int(n * 0.99))] * 1000 if n else None
+        )
+        attained = (
+            sum(1 for s in latencies if s * 1000 <= slo_ms) / n if n else 0.0
+        )
+
+        # -- kill-during-load recovery probe ---------------------------
+        # deterministic shapes for the byte-identity gate: route every
+        # dispatch into the one 16-row bucket for this phase. The probe
+        # body is tools/chaos.serve_kill_probe — the SAME contract the CI
+        # chaos scenario gates, one implementation
+        from tools.chaos import serve_kill_probe
+
+        dep.close()
+        dep = serve.deploy(
+            est, replicas=2, example=x[0],
+            conf={"serve.max_batch_size": 16,
+                  "serve.batch_buckets": [16],
+                  "serve.autoscale.tick_s": 0.1},
+        )
+        kill_probe = serve_kill_probe(dep, x, n_requests=160)
+        return {
+            "slo_ms": slo_ms,
+            "clients": n_clients,
+            "requests": n,
+            "sustained_rps": round(n / elapsed, 1) if elapsed else None,
+            "p50_ms": round(p50_ms, 2) if p50_ms is not None else None,
+            "p99_ms": round(p99_ms, 2) if p99_ms is not None else None,
+            "slo_attained": round(attained, 4),
+            "replica_spinup_s": round(spinup_s / 2, 3),
+            "kill_probe": kill_probe,
+            "ok": bool(
+                n > 0
+                and p99_ms is not None
+                and p99_ms <= slo_ms
+                and kill_probe["ok"]
+            ),
+        }
+    except Exception as exc:  # the bench must report, not crash
+        return {"ok": False, "error": repr(exc)[:300]}
+    finally:
+        if dep is not None:
+            try:
+                dep.close()
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (probe teardown best-effort)
+                pass
+
+
 def interactive_burst(session, df, n_queries: int) -> dict:
     """p50/p99 latency of ``n_queries`` repeated identical-shape queries on
     a live session — the interactive workload of ROADMAP item 1. One warm-up
@@ -1255,6 +1386,12 @@ def main():
         int(os.environ.get("BENCH_DLRM_EPOCHS", 30)),
     )
 
+    # serving probe (raydp_tpu.serve): closed-loop p50/p99 + sustained rps
+    # at a fixed SLO, plus the kill-during-load zero-drop recovery probe —
+    # runs on the cluster the earlier sections left initialized, after all
+    # training clocks (its wall time touches no other metric)
+    serving = serving_probe()
+
     # export the whole run's trace (driver + head + executors under the
     # propagated trace ids) and the merged metrics registries
     trace_path = os.environ.get("BENCH_TRACE_PATH", "bench_trace.json")
@@ -1285,6 +1422,7 @@ def main():
             "epochs": epochs,
             **cmp,
             "obs_metrics": obs_headline,
+            "serving_probe": serving,
             "dlrm": dlrm,
             "lm": bench_transformer_lm(),
             "parallel_steps": bench_parallel_steps(),

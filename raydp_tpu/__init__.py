@@ -38,6 +38,10 @@ _LAZY = {
     # cluster metrics
     "export_trace": ("raydp_tpu.obs", "export_trace"),
     "dump_metrics": ("raydp_tpu.cluster.api", "dump_metrics"),
+    # online serving plane (docs/serving.md): attribute access resolves the
+    # subpackage so `raydp_tpu.serve.deploy(...)` works without an explicit
+    # `import raydp_tpu.serve`
+    "serve": ("raydp_tpu.serve", None),
 }
 
 
@@ -46,7 +50,8 @@ def __getattr__(name):
         import importlib
 
         module, attr = _LAZY[name]
-        value = getattr(importlib.import_module(module), attr)
+        loaded = importlib.import_module(module)
+        value = loaded if attr is None else getattr(loaded, attr)
         globals()[name] = value
         return value
     raise AttributeError(f"module 'raydp_tpu' has no attribute {name!r}")

@@ -32,6 +32,9 @@ import sys
 ZYGOTE_SOCK_FILE = "zygote.sock"
 ZYGOTE_MARKER_FILE = "zygote.pid"
 ZYGOTE_ADOPTION_STAMP_FILE = "adopted.stamp"
+# serving fork template: warm the jax/flax/orbax import set too (set before
+# the zygote starts — i.e. before the first cluster.init on the machine)
+WARM_JAX_ENV = "RAYDP_TPU_ZYGOTE_WARM_JAX"
 
 _listener: socket.socket | None = None
 
@@ -103,6 +106,20 @@ def _warm_imports() -> None:
         import raydp_tpu.store.object_store  # noqa: F401
     except Exception:  # pragma: no cover - partial environments; raydp-lint: disable=swallowed-exceptions (partial environments: children import lazily)
         pass
+    if os.environ.get(WARM_JAX_ENV) == "1":
+        # serving fork template (docs/serving.md): model REPLICAS are light
+        # actors that need the jax/flax/orbax import set (~1-2s cold), which
+        # dominates replica spin-up once the fork itself is ~10ms. Opt-in by
+        # env because (a) a template this heavy is wasted on ETL-only
+        # clusters and (b) children inherit the IMPORTED modules only — no
+        # backend may initialize here (a forked PJRT client is undefined
+        # behavior), so nothing below touches devices.
+        try:
+            import jax  # noqa: F401
+            import flax.linen  # noqa: F401
+            import orbax.checkpoint  # noqa: F401
+        except Exception:  # pragma: no cover - partial environments; raydp-lint: disable=swallowed-exceptions (partial environments: replicas import lazily)
+            pass
 
 
 def _become_worker(req: dict, conn: socket.socket) -> None:

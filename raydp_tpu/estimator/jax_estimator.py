@@ -2038,6 +2038,57 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         return self._params
 
     # ------------------------------------------------------------------
+    # inference loading + predict (the serving plane's path: a replica
+    # restores params from the newest committed checkpoint and serves
+    # module.apply — no optimizer is ever constructed)
+    # ------------------------------------------------------------------
+
+    def load_latest_checkpoint(self):
+        """Restore params from the NEWEST committed checkpoint under
+        ``checkpoint_dir`` (epoch-complete preferred over that epoch's
+        step checkpoints, exactly ``latest_checkpoint``'s ordering) for
+        INFERENCE: unlike the fit-oriented resume path, no optax optimizer
+        is resolved, no opt_state template is built, and nothing is staged
+        to device — the restored host opt_state leaves are dropped on the
+        spot (orbax's StandardCheckpointer restores the saved tree whole;
+        a partial target raises a key-mismatch). Returns ``(epoch, step)``
+        of the checkpoint served (``step`` None for epoch-complete)."""
+        found = latest_checkpoint(self.checkpoint_dir)
+        if found is None:
+            raise FileNotFoundError(
+                f"no committed checkpoint under {self.checkpoint_dir!r}"
+            )
+        epoch, step = found
+        restored = self._restore_checkpoint(epoch, step=step)
+        self._params = restored["params"]  # opt_state dropped host-side
+        if self._module is None:
+            self._module = self._resolve_model()
+        return epoch, step
+
+    def predict(self, batch):
+        """Inference over a host feature batch (numpy array, or a tuple of
+        arrays on the mixed-dtype path) with the current params — available
+        after ``fit()`` OR ``load_latest_checkpoint()``/``load_checkpoint``.
+        Returns host numpy. The jitted apply is cached per module identity
+        (jax's own cache then keys on batch shape), mirroring the evaluate
+        path's _eval_fns_cache so repeated predicts never retrace."""
+        import jax
+
+        if self._params is None:
+            raise RuntimeError(
+                "no params: call fit() or load_latest_checkpoint() first"
+            )
+        if self._module is None:
+            self._module = self._resolve_model()
+        cached = getattr(self, "_predict_fn_cache", None)
+        if cached is not None and cached[0] is self._module:
+            fn = cached[1]
+        else:
+            fn = jax.jit(self._module.apply)
+            self._predict_fn_cache = (self._module, fn)
+        return np.asarray(fn(self._params, batch))
+
+    # ------------------------------------------------------------------
 
     def get_model(self) -> JaxModel:
         if self._params is None:

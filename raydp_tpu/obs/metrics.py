@@ -11,6 +11,7 @@ names the runtime emits.
 
 from __future__ import annotations
 
+import random
 import threading
 from typing import Any, Dict
 
@@ -42,17 +43,27 @@ class Gauge:
 
 
 class Histogram:
-    """count/sum/min/max summary — enough to answer "how many, how much,
-    how bad" without per-observation storage. (Quantiles would need
-    reservoirs; the trace has the individual spans when you need shape.)"""
+    """count/sum/min/max summary plus bounded-reservoir quantiles.
 
-    __slots__ = ("count", "sum", "min", "max")
+    The summary fields answer "how many, how much, how bad" without
+    per-observation storage; p50/p99 come from a fixed-size uniform
+    reservoir (algorithm R) so SLO gauges — the serving plane's latency
+    histograms foremost — get tail shape in O(1) memory. The reservoir is
+    OFF until the first ``observe`` (no allocation for the many histograms
+    that exist only so dump_metrics carries their keys), and the pre-existing
+    snapshot fields are unchanged for old readers — ``p50``/``p99`` are
+    purely additive keys."""
+
+    __slots__ = ("count", "sum", "min", "max", "_reservoir")
+
+    RESERVOIR_SIZE = 512
 
     def __init__(self):
         self.count = 0
         self.sum = 0.0
         self.min = float("inf")
         self.max = float("-inf")
+        self._reservoir = None  # allocated on first observe
 
     def observe(self, value: float) -> None:
         value = float(value)
@@ -62,6 +73,29 @@ class Histogram:
             self.min = value
         if value > self.max:
             self.max = value
+        reservoir = self._reservoir
+        if reservoir is None:
+            reservoir = self._reservoir = []
+        if len(reservoir) < self.RESERVOIR_SIZE:
+            reservoir.append(value)
+        else:
+            # uniform replacement keeps every past observation equally
+            # likely to be resident; like the other instruments this is
+            # lock-free — a racing observe's worst case is one lost sample
+            slot = random.randrange(self.count)
+            if slot < self.RESERVOIR_SIZE:
+                reservoir[slot] = value
+
+    def quantile(self, q: float):
+        """Nearest-rank quantile over the resident reservoir (exact while
+        count <= RESERVOIR_SIZE, a uniform-sample estimate beyond). None
+        before the first observation."""
+        reservoir = self._reservoir
+        if not reservoir:
+            return None
+        ordered = sorted(reservoir)
+        rank = min(len(ordered) - 1, int(q * len(ordered)))
+        return ordered[rank]
 
     def snapshot(self):
         if not self.count:
@@ -73,6 +107,8 @@ class Histogram:
             "min": self.min,
             "max": self.max,
             "mean": self.sum / self.count,
+            "p50": self.quantile(0.50),
+            "p99": self.quantile(0.99),
         }
 
 

@@ -1,0 +1,40 @@
+"""raydp_tpu.serve — the online serving plane.
+
+The first workload in this framework that carries a REQUEST path instead of
+a batch job: model replica actors (zygote-warm-forked) load a
+``JaxEstimator`` checkpoint through the estimator's inference loading path
+and hold AOT-compiled inference jits per (model fingerprint, batch bucket);
+a dynamic batcher drains an admission queue into size- or deadline-triggered
+bucket-padded batches dispatched over the doorbell UDS fast path; an
+SLO-aware controller heals dead replicas and (optionally) autoscales on
+sustained queue-depth/latency gauges; and failover is ZERO-DROP — a request
+whose replica is SIGKILLed mid-flight is re-admitted and re-served
+(inference is pure, so re-execution is byte-safe per batch bucket).
+
+Quick start::
+
+    est.fit_on_etl(train_df)                 # writes checkpoint_dir
+    dep = raydp_tpu.serve.deploy(est, replicas=2, example=row)
+    pred = dep.predict(feature_rows)          # thread-safe, blocking
+    dep.reload()                              # rolling checkpoint reload
+    dep.close()                               # before cluster shutdown
+
+See docs/serving.md for the conf table (``serve.*`` keys), the failover
+semantics, and the observability rows.
+"""
+
+from __future__ import annotations
+
+from raydp_tpu.serve.batcher import DynamicBatcher
+from raydp_tpu.serve.config import ServeConf
+from raydp_tpu.serve.deployment import Deployment, deploy
+from raydp_tpu.serve.replica import ModelReplica, ReplicaSpec
+
+__all__ = [
+    "Deployment",
+    "DynamicBatcher",
+    "ModelReplica",
+    "ReplicaSpec",
+    "ServeConf",
+    "deploy",
+]

@@ -1,0 +1,117 @@
+"""Serving-plane configuration: one place that parses the ``serve.*`` conf
+keys (the same string-keyed conf convention as the ETL session's
+``etl.dynamicAllocation.*`` family — docs/serving.md has the full table)."""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Optional, Tuple
+
+
+def _flag(value, default: bool = True) -> bool:
+    if value is None:
+        return default
+    return str(value).lower() in ("1", "true", "yes")
+
+
+def _buckets(value, max_batch: int) -> Tuple[int, ...]:
+    """The batch-shape bucket ladder. Default: powers of two up to
+    ``max_batch`` (small jit cache, low padding waste). Accepts a sequence
+    or a comma-separated string; always sorted, deduped, capped at
+    max_batch, and containing max_batch itself so every admissible batch
+    has a bucket. A SINGLE bucket (``serve.batch_buckets = [N]``) makes
+    every dispatch one fixed shape — the deterministic-shapes mode the
+    chaos/recovery byte-identity gates run under (XLA numerics are
+    bit-stable per shape, not across shapes)."""
+    if value is None:
+        ladder = []
+        b = 1
+        while b < max_batch:
+            ladder.append(b)
+            b *= 2
+        ladder.append(max_batch)
+        return tuple(ladder)
+    if isinstance(value, str):
+        value = [int(v) for v in value.replace(",", " ").split()]
+    ladder = sorted({int(v) for v in value if 0 < int(v) <= max_batch})
+    if not ladder or ladder[-1] != max_batch:
+        ladder.append(max_batch)
+    return tuple(ladder)
+
+
+@dataclass
+class ServeConf:
+    """Resolved serving knobs for one deployment."""
+
+    # -- batching policy ------------------------------------------------
+    dynamic_batching: bool = True  # off = one dispatch per request, unpadded
+    max_batch_size: int = 64
+    batch_deadline_ms: float = 5.0  # oldest queued request's max wait
+    buckets: Tuple[int, ...] = ()
+    # -- dispatch / failover -------------------------------------------
+    dispatchers: int = 4  # concurrent in-flight batches (doorbell conns)
+    max_retries: int = 8  # re-admissions per request before it errors out
+    request_timeout_s: float = 60.0  # per-dispatch RPC timeout
+    # -- autoscaling ----------------------------------------------------
+    autoscale: bool = False
+    min_replicas: int = 1
+    max_replicas: int = 4
+    tick_s: float = 0.25
+    sustained_ticks: int = 3  # the etl.dynamicAllocation.sustainedStages shape
+    target_queue_per_replica: float = 8.0  # rows of sustained backlog each
+    slo_p99_ms: Optional[float] = None  # latency SLO; breach => scale out
+    # -- replicas -------------------------------------------------------
+    replica_light: bool = True  # zygote warm fork (python -S); see docs
+    replica_max_concurrency: int = 4
+    extra: dict = field(default_factory=dict)
+
+    @classmethod
+    def resolve(cls, conf: Optional[dict]) -> "ServeConf":
+        """Merge precedence: defaults < active ETL session configs (its
+        ``serve.*`` keys, so one conf dict can describe a whole app) < the
+        ``conf`` argument passed to ``deploy``."""
+        merged: dict = {}
+        try:
+            from raydp_tpu.etl.session import active_session
+
+            session = active_session()
+            if session is not None:
+                merged.update(
+                    {k: v for k, v in session.configs.items()
+                     if k.startswith("serve.")}
+                )
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (serving works without any ETL session)
+            pass
+        merged.update(conf or {})
+
+        def get(key, default=None):
+            return merged.get(f"serve.{key}", default)
+
+        max_batch = int(get("max_batch_size", 64))
+        out = cls(
+            dynamic_batching=_flag(get("dynamic_batching"), True),
+            max_batch_size=max_batch,
+            batch_deadline_ms=float(get("batch_deadline_ms", 5.0)),
+            buckets=_buckets(get("batch_buckets"), max_batch),
+            dispatchers=max(1, int(get("dispatchers", 4))),
+            max_retries=int(get("max_retries", 8)),
+            request_timeout_s=float(get("request_timeout_s", 60.0)),
+            autoscale=_flag(get("autoscale.enabled"), False),
+            min_replicas=max(1, int(get("autoscale.min_replicas", 1))),
+            max_replicas=max(1, int(get("autoscale.max_replicas", 4))),
+            tick_s=float(get("autoscale.tick_s", 0.25)),
+            sustained_ticks=max(1, int(get("autoscale.sustained_ticks", 3))),
+            target_queue_per_replica=float(
+                get("autoscale.target_queue_per_replica", 8.0)
+            ),
+            slo_p99_ms=(
+                float(get("slo_p99_ms")) if get("slo_p99_ms") is not None
+                else None
+            ),
+            replica_light=_flag(get("replica_light"), True),
+            replica_max_concurrency=max(
+                2, int(get("replica_max_concurrency", 4))
+            ),
+            extra=merged,
+        )
+        return out

@@ -1,0 +1,329 @@
+"""Deployment: the user-facing handle over a replica pool.
+
+``serve.deploy(estimator, ...)`` (or an explicit model + checkpoint_dir)
+spawns N ``ModelReplica`` actors, wires the dynamic batcher in front of them,
+and starts the controller (healing + optional autoscaling). The deployment
+object is the request client: ``predict(payload)`` is thread-safe and
+blocking — concurrent client threads are the intended usage.
+
+Replica-count management is RECONCILIATION-shaped: every path (explicit
+``scale_to``, autoscaler decisions, failure healing) just moves the pool
+toward ``_target``; races between the controller thread and a user thread
+self-correct on the next pass instead of needing a lock held across spawn
+RPCs (which the blocking-under-lock rule — correctly — forbids). Scale-in
+always drains: the batcher stops routing to the victim, its in-flight
+batches finish, then it is killed.
+
+Rolling reload: ``reload()`` walks the replicas ONE AT A TIME; each replica
+restores the newest checkpoint and AOT-warms it while its old generation
+keeps serving (ModelReplica swaps atomically), so the deployment serves
+every request throughout — from the old weights until that replica's swap,
+from the new after.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import List, Optional
+
+from raydp_tpu import obs, sanitize
+from raydp_tpu.cluster import api as cluster
+from raydp_tpu.cluster.common import ActorState, ClusterError
+from raydp_tpu.serve.autoscaler import ServeController
+from raydp_tpu.serve.batcher import DynamicBatcher
+from raydp_tpu.serve.config import ServeConf
+from raydp_tpu.serve.replica import ModelReplica, ReplicaSpec
+
+
+class Deployment:
+    def __init__(
+        self,
+        spec: ReplicaSpec,
+        conf: ServeConf,
+        replicas: int = 1,
+        feature_columns=None,
+    ):
+        if not cluster.is_initialized():
+            cluster.init()
+        self._spec = spec
+        self._conf = conf
+        self._name = spec.name
+        self._closed = False
+        self._next_idx = 0
+        self._lock = sanitize.named_lock(
+            "serve.deployment", threading.RLock()
+        )
+        # guarded-by: self._lock
+        self._handles: List = []
+        self._target = max(1, int(replicas))
+        if conf.autoscale:
+            self._target = min(
+                max(self._target, conf.min_replicas), conf.max_replicas
+            )
+        self._m_out = obs.metrics.counter("serve.scale_out")
+        self._m_in = obs.metrics.counter("serve.scale_in")
+        self._m_reloads = obs.metrics.counter("serve.reloads")
+        self._m_failovers = obs.metrics.counter("serve.replica_replacements")
+        self._g_replicas = obs.metrics.gauge("serve.replicas")
+        self.batcher = DynamicBatcher(
+            conf,
+            feature_columns=feature_columns,
+            on_replica_failure=self._on_replica_failure,
+        )
+        try:
+            with obs.span(
+                "serve.deploy", deployment=self._name,
+                replicas=self._target,
+            ):
+                self._reconcile()
+            self.controller = ServeController(self, conf)
+        except BaseException:
+            # a deployment that failed to come up must not leave batcher
+            # threads or half-spawned replicas behind the leak audit
+            self._teardown()
+            raise
+
+    # -- replica pool ---------------------------------------------------
+
+    def _spawn_one(self):
+        with self._lock:
+            idx = self._next_idx
+            self._next_idx += 1
+        handle = cluster.spawn(
+            ModelReplica,
+            self._spec,
+            name=f"{self._name}-serve-replica-{idx}",
+            # death is handled by the deployment's own healing (a fresh
+            # spawn reloads the checkpoint), not the head's restart path —
+            # one recovery story instead of two racing ones
+            max_restarts=0,
+            max_concurrency=self._conf.replica_max_concurrency,
+            light=self._conf.replica_light,
+        )
+        return handle
+
+    def _reconcile(self) -> None:
+        """Move the pool to ``_target``. Spawns and drains run OFF the
+        lock; membership mutations under it."""
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+                current = len(self._handles)
+                target = self._target
+            if current < target:
+                try:
+                    handle = self._spawn_one()
+                except (ClusterError, ConnectionError, OSError):
+                    # cluster unreachable (teardown racing a heal tick) or
+                    # spawn rejected: serve on with the survivors rather
+                    # than wedging the controller in a spawn-retry loop
+                    obs.log.warning(
+                        "serve replica spawn failed; continuing with "
+                        "current pool", deployment=self._name, exc_info=True,
+                    )
+                    break
+                with self._lock:
+                    if self._closed or len(self._handles) >= self._target:
+                        surplus = True
+                    else:
+                        self._handles.append(handle)
+                        surplus = False
+                if surplus:  # lost a race; don't leak the spawn
+                    self._kill_quietly(handle)
+                else:
+                    self.batcher.add_replica(handle)
+            elif current > target:
+                with self._lock:
+                    if len(self._handles) <= self._target:
+                        continue
+                    victim = self._handles.pop()  # youngest first
+                # graceful drain: stop routing, let in-flight finish, kill
+                self.batcher.remove_replica(victim.actor_id, drain=True)
+                self._kill_quietly(victim)
+            else:
+                break
+        self._g_replicas.set(self.replica_count())
+
+    @staticmethod
+    def _kill_quietly(handle) -> None:
+        try:
+            handle.kill(no_restart=True)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (victim may already be dead; the head GCs either way)
+            pass
+
+    def _on_replica_failure(self, handle) -> None:
+        # called from a batcher dispatcher thread; the controller's next
+        # tick does the actual replacement — the batcher has already
+        # stopped routing to the failed id
+        obs.log.warning(
+            "serve replica failed; healing on next controller tick",
+            actor_id=handle.actor_id, deployment=self._name,
+        )
+
+    def heal(self) -> int:
+        """Resolve batcher-flagged replicas against the head's verdict
+        (DEAD or unknown: drop and replace; ALIVE: the failure was a
+        transient transport blip, resume routing), probe the rest for
+        silent deaths (a replica SIGKILLed while idle never trips a
+        dispatcher), then reconcile back to target. Returns the number of
+        replicas replaced."""
+        with self._lock:
+            if self._closed:
+                return 0
+            snapshot = list(self._handles)
+        flagged = set(self.batcher.failed_ids())
+        dead = []
+        for handle in snapshot:
+            gone = False
+            try:
+                gone = handle.state() == ActorState.DEAD
+            except ClusterError:
+                gone = True  # unknown to the head = not servable
+            if gone:
+                dead.append(handle)
+            elif handle.actor_id in flagged:
+                self.batcher.add_replica(handle)  # transient: clear the flag
+        if not dead:
+            return 0
+        with self._lock:
+            for handle in dead:
+                if handle in self._handles:
+                    self._handles.remove(handle)
+        for handle in dead:
+            self.batcher.remove_replica(handle.actor_id, drain=False)
+        self._m_failovers.inc(len(dead))
+        obs.instant(
+            "serve.replica_replaced", count=len(dead), deployment=self._name
+        )
+        self._reconcile()
+        return len(dead)
+
+    def replica_count(self) -> int:
+        with self._lock:
+            return len(self._handles)
+
+    def scale_to(self, n: int) -> None:
+        """Explicit scale (also the autoscaler's actuator). Scale-in drains
+        gracefully; scale-out spawns warm zygote forks."""
+        n = max(1, int(n))
+        with self._lock:
+            old = self._target
+            self._target = n
+        if n > old:
+            self._m_out.inc(n - old)
+        elif n < old:
+            self._m_in.inc(old - n)
+        self._reconcile()
+
+    # -- request surface ------------------------------------------------
+
+    def predict(self, payload, timeout: Optional[float] = None):
+        """Blocking inference; thread-safe — this IS the client."""
+        return self.batcher.predict(payload, timeout)
+
+    def submit(self, payload):
+        """Async variant: returns a request whose ``.result(timeout)``
+        yields the prediction rows."""
+        return self.batcher.submit(payload)
+
+    # -- lifecycle ------------------------------------------------------
+
+    def reload(self) -> List[dict]:
+        """Rolling checkpoint reload: one replica at a time picks up the
+        newest committed checkpoint; old weights serve until each replica's
+        new generation is warm. Returns the per-replica info dicts."""
+        with self._lock:
+            snapshot = list(self._handles)
+        infos = []
+        with obs.span("serve.reload", deployment=self._name,
+                      replicas=len(snapshot)):
+            for handle in snapshot:
+                infos.append(handle.reload.remote().result())
+        self._m_reloads.inc()
+        return infos
+
+    def infos(self) -> List[dict]:
+        with self._lock:
+            snapshot = list(self._handles)
+        return [h.info.remote().result() for h in snapshot]
+
+    def stats(self) -> dict:
+        out = self.batcher.stats()
+        out["target_replicas"] = self._target
+        out["doorbell_pooled"] = int(
+            obs.metrics.counter("serve.doorbell_pooled").value
+        )
+        return out
+
+    def _teardown(self) -> None:
+        controller = getattr(self, "controller", None)
+        if controller is not None:
+            controller.close()
+        batcher = getattr(self, "batcher", None)
+        if batcher is not None:
+            batcher.close()
+        with self._lock:
+            self._closed = True
+            victims = list(self._handles)
+            self._handles.clear()
+        for handle in victims:
+            self._kill_quietly(handle)
+        self._g_replicas.set(0)
+
+    def close(self) -> None:
+        """Stop serving: controller and batcher threads join (pending
+        requests fail with a closed error), replicas are killed. Idempotent;
+        call before ``cluster.shutdown()`` so the leak audit stays clean."""
+        with self._lock:
+            if self._closed:
+                return
+        self._teardown()
+
+    def __enter__(self) -> "Deployment":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        self.close()
+
+
+def deploy(
+    estimator=None,
+    *,
+    model=None,
+    checkpoint_dir: Optional[str] = None,
+    name: str = "default",
+    replicas: int = 1,
+    conf: Optional[dict] = None,
+    example=None,
+    feature_columns=None,
+) -> Deployment:
+    """Stand up an online serving deployment for a trained model.
+
+    Pass a fitted/configured ``JaxEstimator`` (its model, feature columns and
+    ``checkpoint_dir`` are adopted — weights always travel via the
+    checkpoint, never by value) or an explicit ``model`` + ``checkpoint_dir``.
+    ``example`` (one feature row) lets replicas AOT-compile every batch
+    bucket at boot so no request ever pays a compile. ``conf`` takes
+    ``serve.*`` keys (docs/serving.md); an active ETL session's ``serve.*``
+    configs are merged underneath it."""
+    if estimator is not None:
+        model = model if model is not None else estimator._model_arg
+        checkpoint_dir = checkpoint_dir or estimator.checkpoint_dir
+        if feature_columns is None:
+            feature_columns = list(estimator.feature_columns) or None
+    if model is None or not checkpoint_dir:
+        raise ValueError(
+            "deploy needs an estimator, or model= plus checkpoint_dir="
+        )
+    resolved = ServeConf.resolve(conf)
+    spec = ReplicaSpec(
+        model=model,
+        checkpoint_dir=checkpoint_dir,
+        buckets=resolved.buckets,
+        example=example,
+        name=name,
+    )
+    return Deployment(
+        spec, resolved, replicas=replicas, feature_columns=feature_columns
+    )

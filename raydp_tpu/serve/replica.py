@@ -1,0 +1,213 @@
+"""Model replica actors: the serving plane's unit of capacity.
+
+A replica is an ordinary cluster actor (zygote-warm-forked like every light
+actor — set ``RAYDP_TPU_ZYGOTE_WARM_JAX=1`` before the first ``cluster.init``
+on a machine to bake the jax/flax/orbax import set into the fork template and
+make replica spin-up fork-bound) that
+
+- loads a ``JaxEstimator`` checkpoint through the estimator's INFERENCE
+  loading path (``load_latest_checkpoint``: params only, no optimizer state,
+  nothing fit-oriented),
+- holds an AOT-compiled inference jit per (model fingerprint, batch-shape
+  bucket) — the exact executor-resident-program shape of the PR 6 compiled
+  ETL plane: the batcher pads every dispatch to a configured bucket, so the
+  cache stays small and every bucket's numerics are bit-stable (XLA lowers
+  per shape; at a FIXED shape per-row results are independent of batch
+  composition, which is what makes kill/no-kill byte-identity gates honest),
+- swaps (fingerprint, params, compiled-cache) ATOMICALLY on ``reload``: the
+  old jit serves every in-flight and concurrent request until the new
+  weights are restored AND compiled warm, so a rolling checkpoint reload
+  never serves half-loaded state.
+
+Inference is pure and stateless between requests — a re-dispatched request
+(replica SIGKILLed mid-flight) recomputes the identical answer, which is the
+whole basis of the batcher's zero-drop re-admission.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import threading
+from dataclasses import dataclass, field
+from typing import Any, Optional, Tuple
+
+import numpy as np
+
+from raydp_tpu.exchange.features import f0, fmap
+
+
+@dataclass
+class ReplicaSpec:
+    """Everything a replica process needs to build its model and serve it.
+    Travels cloudpickled inside the actor spawn spec; deliberately holds NO
+    trained weights — the checkpoint directory is the weight channel, which
+    is what makes rolling reload and post-crash respawn trivially correct."""
+
+    model: Any  # flax Module instance or zero-arg creator fn
+    checkpoint_dir: str
+    buckets: Tuple[int, ...] = (1, 2, 4, 8, 16, 32, 64)
+    # optional example feature row(s): lets the replica AOT-compile every
+    # bucket at load time (boot and reload both), so no request ever pays a
+    # compile. Without it buckets compile lazily on first use.
+    example: Any = None
+    name: str = "default"
+    extra_estimator_kwargs: dict = field(default_factory=dict)
+
+
+class _ModelState:
+    """One immutable generation of servable state. ``infer`` reads the
+    replica's ``_active`` reference once and works off this object alone, so
+    a concurrent reload (which builds a whole new _ModelState and swaps the
+    reference) can never expose a torn view."""
+
+    __slots__ = ("fingerprint", "epoch", "step", "params", "jitted", "compiled")
+
+    def __init__(self, fingerprint, epoch, step, params, jitted):
+        self.fingerprint = fingerprint
+        self.epoch = epoch
+        self.step = step
+        self.params = params
+        self.jitted = jitted
+        self.compiled = {}  # shape key -> AOT-compiled executable
+
+    def _shape_key(self, x):
+        if isinstance(x, tuple):
+            return tuple((a.shape, str(a.dtype)) for a in x)
+        return ((x.shape, str(x.dtype)),)
+
+    # with dynamic batching ON the shape set is exactly the bucket ladder;
+    # OFF dispatches raw request shapes — bound the cache so an adversarial
+    # shape stream cannot grow it without limit (PR 6's executor program
+    # cache makes the same call, LRU 32)
+    MAX_COMPILED = 32
+
+    def compiled_for(self, x):
+        """The AOT executable for this batch's exact shapes, compiling on
+        miss. Lock-free: two threads racing the same miss both compile and
+        one wins the dict slot — wasteful once, never wrong."""
+        key = self._shape_key(x)
+        fn = self.compiled.get(key)
+        if fn is None:
+            import jax
+
+            from raydp_tpu import obs
+
+            def sds(a):
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+            with obs.span("serve.replica_compile", bucket=int(len(f0(x)))):
+                fn = self.jitted.lower(
+                    jax.tree.map(sds, self.params), fmap(sds, x)
+                ).compile()
+            obs.metrics.counter("serve.replica.compiles").inc()
+            while len(self.compiled) >= self.MAX_COMPILED:
+                try:
+                    self.compiled.pop(next(iter(self.compiled)), None)
+                except (StopIteration, RuntimeError):  # raydp-lint: disable=swallowed-exceptions (a racing evictor emptied/mutated the dict first; the cache is already under its bound)
+                    break
+            self.compiled[key] = fn
+        return fn
+
+
+class ModelReplica:
+    """The actor class. Spawned with ``max_concurrency >= 2`` so ``reload``
+    (and health probes) proceed while ``infer`` traffic is in flight."""
+
+    def __init__(self, spec: ReplicaSpec):
+        self._spec = spec
+        self._active: Optional[_ModelState] = None
+        # serializes reloads only — infer never takes it (infer reads the
+        # _active reference, which swaps atomically)
+        from raydp_tpu import sanitize
+
+        self._reload_lock = sanitize.named_lock(
+            "serve.replica_reload", threading.Lock()
+        )
+        from raydp_tpu.estimator.jax_estimator import JaxEstimator
+
+        self._est = JaxEstimator(
+            model=spec.model,
+            checkpoint_dir=spec.checkpoint_dir,
+            **dict(spec.extra_estimator_kwargs),
+        )
+        self._load()  # a replica is never "up but weightless"
+
+    # -- lifecycle -----------------------------------------------------
+
+    def _load(self) -> dict:
+        """Restore the newest committed checkpoint and build a fresh
+        generation, warming the configured buckets BEFORE the swap: until
+        the new state is compiled, ``self._active`` (the old weights) keeps
+        serving — the rolling-reload contract."""
+        import jax
+
+        from raydp_tpu import obs
+
+        with self._reload_lock:
+            epoch, step = self._est.load_latest_checkpoint()
+            fingerprint = hashlib.blake2b(
+                f"{self._spec.checkpoint_dir}:{epoch}:{step}".encode(),
+                digest_size=8,
+            ).hexdigest()
+            state = _ModelState(
+                fingerprint, epoch, step, self._est._params,
+                jax.jit(self._est._module.apply),
+            )
+            if self._spec.example is not None:
+                from raydp_tpu.exchange.features import (
+                    as_feature_rows,
+                    pad_rows,
+                )
+
+                rows = as_feature_rows(self._spec.example)
+                for bucket in self._spec.buckets:
+                    if int(bucket) >= len(f0(rows)):
+                        state.compiled_for(pad_rows(rows, int(bucket)))
+            self._active = state  # the atomic swap: new weights go live here
+            obs.metrics.counter("serve.replica.reloads").inc()
+            obs.flush_throttled()
+            return self.info()
+
+    def infer(self, x, n_valid: int):
+        """Run the batch through the active generation and return the FIRST
+        ``n_valid`` prediction rows as host numpy — padded rows are sliced
+        off server-side, so they cannot leak into any response."""
+        from raydp_tpu import obs
+
+        state = self._active
+        fn = state.compiled_for(x)
+        out = np.asarray(fn(state.params, x))[: int(n_valid)]
+        obs.metrics.counter("serve.replica.infers").inc()
+        obs.metrics.counter("serve.replica.rows").inc(int(n_valid))
+        obs.flush_throttled()
+        return out
+
+    def reload(self) -> dict:
+        """Pick up the newest checkpoint (rolling reload entry point). Old
+        weights serve until the new generation is restored and warm."""
+        return self._load()
+
+    def warm(self, example) -> int:
+        """Precompile every configured bucket for ``example``'s row shape;
+        returns the number of compiled entries in the active generation."""
+        from raydp_tpu.exchange.features import as_feature_rows, pad_rows
+
+        state = self._active
+        rows = as_feature_rows(example)
+        for bucket in self._spec.buckets:
+            if int(bucket) >= len(f0(rows)):
+                state.compiled_for(pad_rows(rows, int(bucket)))
+        return len(state.compiled)
+
+    def info(self) -> dict:
+        import os
+
+        state = self._active
+        return {
+            "name": self._spec.name,
+            "pid": os.getpid(),
+            "fingerprint": state.fingerprint if state else None,
+            "epoch": state.epoch if state else None,
+            "step": state.step if state else None,
+            "buckets_compiled": len(state.compiled) if state else 0,
+        }

@@ -82,6 +82,38 @@ def test_metrics_registry_snapshot():
         reg.gauge("c")  # type confusion must fail loudly
 
 
+def test_histogram_reservoir_quantiles():
+    """The bounded-reservoir quantile estimator (serving SLO gauges): exact
+    nearest-rank while observations fit the reservoir, fixed memory beyond,
+    OFF (no allocation) until the first observe, and the pre-quantile
+    snapshot fields byte-compatible for old readers."""
+    from raydp_tpu.obs.metrics import Histogram
+
+    h = Histogram()
+    # off until first observe: no reservoir allocated, empty snapshot is
+    # byte-identical to the pre-quantile shape
+    assert h._reservoir is None
+    assert h.snapshot() == {"type": "histogram", "count": 0, "sum": 0.0}
+    assert h.quantile(0.5) is None
+
+    for v in range(100):  # 0..99: exact regime (fits the reservoir)
+        h.observe(float(v))
+    snap = h.snapshot()
+    # additive keys only; the old fields carry their old values
+    assert snap["count"] == 100 and snap["min"] == 0.0 and snap["max"] == 99.0
+    assert snap["p50"] == 50.0 and snap["p99"] == 99.0
+
+    # beyond the reservoir: memory stays fixed, the estimate stays sane
+    for v in range(100, 20_000):
+        h.observe(float(v))
+    assert len(h._reservoir) == Histogram.RESERVOIR_SIZE
+    snap = h.snapshot()
+    assert snap["count"] == 20_000
+    # a uniform sample of 0..19999: p50 near 10k, p99 in the top decile
+    assert 5_000 < snap["p50"] < 15_000
+    assert snap["p99"] > 15_000
+
+
 def test_ring_buffer_bounded_and_drop_counted():
     tracing.set_enabled(True)
     try:

@@ -32,9 +32,16 @@ Usage::
     RAYDP_TPU_SANITIZE=donation,lockdep,leaks-strict \
         python -m tools.chaos --quick --seed 7 --json chaos_report.json
 
-``--quick`` runs the CI slice (mid-shuffle + mid-fit lineage kills, plus
-both block-service tiers); without it the full scenario list runs (adds
-the compiled-dispatch kill and the elasticity round-trip). ``--seed``
+The serving plane adds a third tier: ``replica_kill_during_load`` SIGKILLs
+a model replica mid-request-stream and gates ZERO dropped requests plus
+responses byte-identical to an unkilled run (the deployment pins a single
+batch bucket — XLA numerics are bit-stable per shape — and re-admitted
+requests are pure re-computation; docs/serving.md "Failover").
+
+``--quick`` runs the CI slice (mid-shuffle + mid-fit lineage kills, both
+block-service tiers, and the replica kill); without it the full scenario
+list runs (adds the compiled-dispatch kill and the elasticity
+round-trip). ``--seed``
 makes victim/timing selection deterministic (unseeded runs keep the fixed
 legacy choices). Exit code is non-zero when any query went unrecovered or
 any sanitizer finding surfaced. The same scenario bodies are reused by
@@ -138,6 +145,99 @@ def kill_service(session):
     victim.kill(no_restart=True)
     store.note_owner_dead(victim._actor_id)
     return victim
+
+
+def serve_request_stream(dep, x, n_requests: int, n_clients: int = 4):
+    """Drive a FIXED single-row request list through a serving deployment
+    from ``n_clients`` closed-loop client threads. Returns (results,
+    errors) with results positionally stable, so two runs of the same
+    stream are comparable row-for-row. Shared by the chaos scenario, the
+    bench kill probe, and tests — one body, no drift."""
+
+    results = [None] * n_requests
+    errors: List[str] = []
+    rows = len(x)
+
+    def client(lo: int, hi: int) -> None:
+        for i in range(lo, hi):
+            try:
+                results[i] = dep.predict(x[i % rows : i % rows + 1])
+            except Exception as exc:  # noqa: BLE001 - the gate counts these
+                errors.append(repr(exc)[:200])
+
+    share = max(1, n_requests // n_clients)
+    workers = [
+        threading.Thread(
+            target=client,
+            args=(k * share, min(n_requests, (k + 1) * share)),
+        )
+        for k in range(n_clients)
+    ]
+    for w in workers:
+        w.start()
+    for w in workers:
+        w.join()
+    return results, errors
+
+
+def serve_kill_probe(
+    dep,
+    x,
+    n_requests: int = 160,
+    kill_delay_s: float = 0.05,
+    pick_victim: Optional[Callable[[], int]] = None,
+    heal_timeout_s: float = 20.0,
+) -> dict:
+    """The serving zero-drop contract, as one reusable probe: run a fixed
+    request stream clean, re-run it with a replica SIGKILLed mid-stream
+    (``pick_victim`` chooses the index at fire time; seeded scenarios pass
+    ``pick_index``), and gate ZERO dropped requests + responses
+    byte-identical to the clean run + the pool healed back to target.
+    The deployment should pin a single batch bucket so every dispatch is
+    one fixed shape (docs/serving.md: XLA numerics are bit-stable per
+    shape, which is what makes cross-run byte-identity honest)."""
+    import numpy as np
+
+    from raydp_tpu import obs
+
+    target = dep.replica_count()
+    clean, clean_errors = serve_request_stream(dep, x, n_requests)
+    dropped_before = obs.metrics.counter("serve.dropped_requests").value
+
+    def _fire():
+        time.sleep(jittered(kill_delay_s))
+        try:
+            idx = pick_victim() if pick_victim is not None else 0
+            dep._handles[idx].kill(no_restart=True)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (probe timer: replica may already be gone, racing teardown)
+            pass
+
+    killer = threading.Thread(target=_fire, daemon=True)
+    killer.start()
+    killed, killed_errors = serve_request_stream(dep, x, n_requests)
+    killer.join()
+    dropped = int(
+        obs.metrics.counter("serve.dropped_requests").value - dropped_before
+    )
+    identical = (
+        not clean_errors
+        and not killed_errors
+        and all(r is not None for r in clean)
+        and all(r is not None for r in killed)
+        and all(np.array_equal(a, b) for a, b in zip(clean, killed))
+    )
+    deadline = time.monotonic() + heal_timeout_s
+    while dep.replica_count() < target and time.monotonic() < deadline:
+        time.sleep(0.05)
+    healed = dep.replica_count() == target
+    return {
+        "requests": n_requests,
+        "dropped": dropped,
+        "byte_identical": bool(identical),
+        "pool_healed": bool(healed),
+        "ok": bool(identical and dropped == 0 and healed),
+        "errors": (clean_errors + killed_errors)[:3],
+    }
 
 
 def block_owner_executor(session, ds):
@@ -537,11 +637,91 @@ def scenario_service_kill_lineage_fallback(rows: int = 60_000) -> dict:
         raydp_tpu.stop_etl()
 
 
+def scenario_replica_kill_during_load(n_requests: int = 240) -> dict:
+    """The serving plane's zero-drop contract (docs/serving.md): SIGKILL a
+    model replica MID-REQUEST-STREAM and gate on
+
+    - ZERO dropped requests (every client future resolves — in-flight
+      batches on the dead replica are re-admitted and re-served, pure
+      inference being idempotent), and
+    - responses BYTE-IDENTICAL to an unkilled run of the same stream. The
+      deployment pins a single batch bucket so every dispatch is one fixed
+      shape: XLA numerics are bit-stable per shape regardless of batch
+      composition, which makes cross-run byte-identity an honest gate.
+
+    The controller must also heal the pool back to target. Runs under the
+    same strict sanitizers as every scenario; replica/batcher/controller
+    threads and sockets all land in the shutdown leak audit."""
+    import numpy as np
+    import pandas as pd
+
+    import raydp_tpu
+    from raydp_tpu import obs, serve
+    from raydp_tpu.estimator import JaxEstimator
+    from raydp_tpu.models import MLPRegressor
+
+    import tempfile
+
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos-serve-ckpt-")
+    rng = np.random.default_rng(5)
+    rows = 1024
+    pdf = pd.DataFrame(
+        {
+            "a": rng.random(rows).astype(np.float32),
+            "b": rng.random(rows).astype(np.float32),
+        }
+    )
+    pdf["y"] = 2 * pdf["a"] + 3 * pdf["b"]
+    session = _fresh_session("chaos-serve")
+    dep = None
+    try:
+        est = JaxEstimator(
+            model=MLPRegressor(hidden=(8,)), optimizer="adam", loss="mse",
+            feature_columns=["a", "b"], label_column="y", batch_size=64,
+            num_epochs=1, seed=0, checkpoint_dir=ckpt_dir,
+            donate_state=False,
+        )
+        est.fit_on_etl(session.from_pandas(pdf, num_partitions=2))
+        x = pdf[["a", "b"]].to_numpy(np.float32)
+        dep = serve.deploy(
+            est, replicas=2, example=x[0],
+            conf={
+                "serve.max_batch_size": 16,
+                "serve.batch_buckets": [16],  # deterministic shapes
+                "serve.autoscale.tick_s": 0.1,
+            },
+        )
+
+        probe = serve_kill_probe(
+            dep, x, n_requests=n_requests,
+            pick_victim=lambda: pick_index(dep.replica_count()),
+        )
+        return {
+            "name": "replica_kill_during_load",
+            "ok": probe["ok"],
+            "byte_identical": probe["byte_identical"],
+            "dropped_requests": probe["dropped"],
+            "requeued_requests": int(
+                obs.metrics.counter("serve.requeued_requests").value
+            ),
+            "replica_replacements": int(
+                obs.metrics.counter("serve.replica_replacements").value
+            ),
+            "pool_healed": probe["pool_healed"],
+            "errors": probe["errors"],
+        }
+    finally:
+        if dep is not None:
+            dep.close()
+        raydp_tpu.stop_etl()
+
+
 QUICK = (
     scenario_mid_shuffle,
     scenario_mid_fit,
     scenario_executor_kill_with_service,
     scenario_service_kill_lineage_fallback,
+    scenario_replica_kill_during_load,
 )
 FULL = (
     scenario_mid_shuffle,
@@ -550,6 +730,7 @@ FULL = (
     scenario_executor_kill_with_service,
     scenario_service_kill_lineage_fallback,
     scenario_elasticity,
+    scenario_replica_kill_during_load,
 )
 
 
@@ -610,8 +791,9 @@ def run(scenarios) -> dict:
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__)
     parser.add_argument("--quick", action="store_true",
-                        help="CI slice: mid-shuffle + mid-fit lineage kills "
-                             "plus both block-service tiers")
+                        help="CI slice: mid-shuffle + mid-fit lineage kills, "
+                             "both block-service tiers, and the serving "
+                             "replica kill")
     parser.add_argument("--seed", type=int, default=None,
                         help="deterministic victim/timing selection "
                              "(unseeded keeps the fixed legacy choices)")

@@ -36,7 +36,16 @@ when:
   through lineage with ≥1 re-executed task. ``recovery_overhead`` itself is
   reported, not gated — but the etl_query_s/burst gates above hold the
   CLEAN path to <25% regression vs the r08 snapshot, i.e. the block-service
-  handoff (like the lineage bookkeeping before it) must be ~free.
+  handoff (like the lineage bookkeeping before it) must be ~free;
+- the serving probe's closed-loop p99 exceeds its fixed SLO
+  (``BENCH_SERVE_SLO_MS``, 250ms — an absolute smoke budget like the
+  consumer-idle gate: generous vs the ~7ms measured on a 2-core box, it
+  catches structural request-path regressions such as a per-request
+  compile or a fresh connect per dispatch);
+- the serving kill-during-load probe failed zero-drop recovery: a replica
+  SIGKILL mid-stream must drop ZERO requests, return responses
+  byte-identical to an unkilled run, and the pool must heal to target
+  (docs/serving.md "Failover").
 
 Usage: ``python tools/perf_smoke.py [artifact.json]``
 """
@@ -116,6 +125,7 @@ def main() -> int:
         ),
         "streaming_ingest_probe": detail.get("streaming_ingest_probe", {}),
         "recovery_probe": detail.get("recovery_probe", {}),
+        "serving_probe": detail.get("serving_probe", {}),
         "recovery_overhead": detail.get("recovery_overhead"),
         "etl_breakdown": detail.get("etl_breakdown", {}),
         "shuffle_probe": detail.get("shuffle_probe", {}),
@@ -188,6 +198,25 @@ def main() -> int:
             "service OFF: the same kill must recover byte-correct via "
             "lineage with ≥1 re-executed task)"
         )
+    serving = artifact["serving_probe"]
+    if serving:
+        slo = serving.get("slo_ms")
+        p99 = serving.get("p99_ms")
+        if p99 is None or (slo is not None and p99 > slo):
+            failures.append(
+                f"serving p99 {p99}ms exceeds the {slo}ms SLO budget "
+                "(closed-loop probe: a structural request-path regression — "
+                "per-request compile, fresh connects, batcher stall)"
+            )
+        kill = serving.get("kill_probe", {})
+        if not kill.get("ok"):
+            failures.append(
+                f"serving kill-during-load probe failed: {kill} (a replica "
+                "SIGKILL mid-stream must drop zero requests, stay "
+                "byte-identical to an unkilled run, and heal the pool)"
+            )
+    else:
+        failures.append("serving_probe missing from bench detail")
     for entry in artifact["shuffle_probe"].get("shuffle", []):
         if entry.get("indexed") and entry["blocks"] > entry["map_tasks"]:
             failures.append(
