@@ -42,6 +42,9 @@ _LAZY = {
     # subpackage so `raydp_tpu.serve.deploy(...)` works without an explicit
     # `import raydp_tpu.serve`
     "serve": ("raydp_tpu.serve", None),
+    # multi-tenant control plane (docs/multitenancy.md): session registry,
+    # fair-share scheduler, per-tenant quotas/accounting
+    "tenancy": ("raydp_tpu.tenancy", None),
 }
 
 

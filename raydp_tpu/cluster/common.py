@@ -51,6 +51,26 @@ class OwnerDiedError(ClusterError):
     test_data_owner_transfer.py:33-77)."""
 
 
+class TenantQuotaError(ClusterError):
+    """A tenant exceeded one of its quotas (max block bytes at the head,
+    max in-flight / queued tasks at the fair-share scheduler). Typed so
+    callers can tell an over-quota rejection from an infrastructure failure
+    — the multi-tenant contract is reject-fast, never wedge the queue
+    (docs/multitenancy.md). Carries ``tenant`` when known; defined here so
+    it pickles across the head RPC boundary like every cluster error."""
+
+    tenant: str = ""
+
+
+def tenant_of_object(object_id: str) -> str:
+    """The tenant namespace encoded in a block's object id (empty for
+    unprefixed ids — single-session / tenancy-off blocks). Tenant-scoped
+    writers mint ids as ``<tenant>.<hex16>`` (store.new_object_id); the hex
+    tail never contains a dot, so the LAST dot splits unambiguously."""
+    head, sep, _tail = object_id.rpartition(".")
+    return head if sep else ""
+
+
 class ActorState(str, enum.Enum):
     PENDING = "PENDING"
     ALIVE = "ALIVE"

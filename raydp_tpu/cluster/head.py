@@ -35,12 +35,14 @@ from raydp_tpu.cluster.common import (
     ClusterError,
     NodeRecord,
     OwnerDiedError,
+    TenantQuotaError,
     actor_sock_path,
     connect,
     head_sock_path,
     recv_frame,
     rpc,
     send_frame,
+    tenant_of_object,
     unwrap_traced,
 )
 from raydp_tpu import sanitize
@@ -136,13 +138,24 @@ class Head:
         self.named: Dict[str, str] = {}  # name -> actor_id; guarded-by: self.lock|self.actor_state_cond
         self.pgs: Dict[str, _PlacementGroup] = {}  # guarded-by: self.lock|self.actor_state_cond
         self.objects: Dict[str, _ObjectMeta] = {}  # guarded-by: self.lock|self.actor_state_cond
-        # owner-kind metadata: shm namespace -> block-service actor id (one
-        # per host — every virtual node on a machine shares /dev/shm, so the
-        # namespace IS the host key). Registrations flagged ``handoff`` are
-        # recorded under the namespace's LIVE service instead of the writing
-        # executor, which is what makes executor death lose zero blocks
-        # (store/block_service.py; docs/fault_tolerance.md).
-        self.block_services: Dict[str, str] = {}  # guarded-by: self.lock|self.actor_state_cond
+        # owner-kind metadata: (shm namespace, tenant) -> block-service
+        # actor id (one per host per TENANT — every virtual node on a
+        # machine shares /dev/shm, so the namespace is the host key; the
+        # tenant key is what keeps one session's stop from tombstoning
+        # blocks another session's handoffs adopted, the multi-tenant
+        # isolation contract). Registrations flagged ``handoff`` are
+        # recorded under the writing tenant's LIVE service instead of the
+        # writing executor, which is what makes executor death lose zero
+        # blocks (store/block_service.py; docs/fault_tolerance.md). A
+        # tenant-less registration (key ("", "") — the pre-tenancy shape)
+        # serves as the fallback for any tenant in its namespace.
+        self.block_services: Dict[tuple, str] = {}  # guarded-by: self.lock|self.actor_state_cond
+        # tenant table (raydp_tpu.tenancy, docs/multitenancy.md): one record
+        # per named tenant — active flag, fair-share weight, block-bytes
+        # quota, and live bytes/blocks accounting charged from the object
+        # table by id prefix. Passive records (active=False) accumulate for
+        # unregistered tenants so accounting never silently drops bytes.
+        self.tenants: Dict[str, dict] = {}  # guarded-by: self.lock|self.actor_state_cond
         # owner-death tombstones: object_id -> dead owner. When an owner
         # dies, its metas are POPPED (proactive unregister — they used to
         # linger as owner_died records until a reader tripped over them)
@@ -219,14 +232,23 @@ class Head:
                 resources, node_ip, agent_addr=agent_addr, shm_ns=shm_ns
             )
 
-    def handle_remove_node(self, node_id: str):
+    def handle_remove_node(self, node_id: str, only_if_empty: bool = False):
         """Kill a virtual node and every actor process on it (elasticity testing,
         parity: ray.cluster_utils.Cluster.remove_node used at reference
-        test_spark_cluster.py:166-196)."""
+        test_spark_cluster.py:166-196). ``only_if_empty`` makes it a safe
+        RETIREMENT instead: if any non-DEAD actor sits on the node, return
+        False and touch nothing — the tenancy attach-node cleanup path,
+        where a co-tenant's actor may have been scheduled onto the capacity
+        this tenant added and must never be collateral."""
         with self.lock:
             node = self.nodes.get(node_id)
             if node is None or not node.alive:
                 raise ClusterError(f"unknown or dead node {node_id}")
+            if only_if_empty and any(
+                a.node_id == node_id and a.state != ActorState.DEAD
+                for a in self.actors.values()
+            ):
+                return False
             node.alive = False
             obs_log.warning(
                 "node removed", node_id=node_id, node_ip=node.node_ip,
@@ -789,14 +811,14 @@ class Head:
             self.actor_state_cond.notify_all()
             self._on_owner_dead(actor.spec.actor_id)
             # a DEAD block service must not keep adopting registrations —
-            # drop its owner-kind entry so handoffs fall back to executor
+            # drop its owner-kind entries so handoffs fall back to executor
             # ownership (lineage then covers those blocks, the PR 8 tier)
-            for ns in [
-                ns
-                for ns, a in self.block_services.items()
+            for key in [
+                key
+                for key, a in self.block_services.items()
                 if a == actor.spec.actor_id
             ]:
-                del self.block_services[ns]
+                del self.block_services[key]
             if actor.spec.name is not None:
                 # keep the name → id mapping so get_actor(name) reports DEAD
                 pass
@@ -831,43 +853,62 @@ class Head:
 
     # ---------- block services (per-host owner-of-record actors) ----------
 
-    def handle_block_service_register(self, actor_id: str):
+    def handle_block_service_register(self, actor_id: str, tenant: str = ""):
         """Adopt a spawned BlockService actor as the owner of record for its
-        node's shared-memory namespace. Returns the namespace it serves."""
+        node's shared-memory namespace (scoped to ``tenant`` when given —
+        the multi-tenant shape; a tenant-less registration is the namespace
+        fallback any tenant's handoffs may adopt, the pre-tenancy behavior).
+        Returns the namespace it serves."""
         with self.lock:
             actor = self.actors.get(actor_id)
             if actor is None:
                 raise ClusterError(f"unknown block-service actor {actor_id}")
             node = self.nodes.get(actor.node_id) if actor.node_id else None
             ns = node.shm_ns if node is not None else ""
-            self.block_services[ns] = actor_id
-        obs_instant("block_service.registered", actor_id=actor_id, shm_ns=ns)
+            self.block_services[(ns, tenant or "")] = actor_id
+        obs_instant(
+            "block_service.registered", actor_id=actor_id, shm_ns=ns,
+            tenant=tenant or "",
+        )
         return ns
 
     def handle_block_service_unregister(self, actor_id: str):
         """Drop a service from the owner-kind table (A/B toggle; its already-
         owned blocks keep their owner — only FUTURE handoffs fall back)."""
         with self.lock:
-            for ns in [
-                ns for ns, a in self.block_services.items() if a == actor_id
+            for key in [
+                key for key, a in self.block_services.items() if a == actor_id
             ]:
-                del self.block_services[ns]
+                del self.block_services[key]
         return True
 
-    def handle_block_service_lookup(self, shm_ns: str = ""):
+    def handle_block_service_lookup(self, shm_ns: str = "", tenant: str = ""):
         with self.lock:
-            return self.block_services.get(shm_ns)
+            return self._service_for(shm_ns, tenant)
 
-    def _effective_owner(self, owner: str, shm_ns: str, handoff: bool) -> str:  # guarded-by: self.lock|self.actor_state_cond held
-        """The owner of record for a new registration: the namespace's LIVE
-        block service when the writer flagged the entry for handoff, else
-        the writer itself. Deciding HERE (the head knows actor liveness
-        authoritatively) means a dead/bouncing service degrades registrations
-        to executor ownership instead of parking blocks on a corpse owner
-        that no death event will ever GC."""
+    def _service_for(self, shm_ns: str, tenant: str) -> Optional[str]:  # guarded-by: self.lock|self.actor_state_cond held
+        """The block service serving (namespace, tenant): the tenant-scoped
+        entry first, then the namespace's tenant-less fallback. A tenant-
+        scoped service NEVER serves another tenant — that is what keeps
+        tenant A's session stop (which kills A's service and tombstones its
+        blocks) from ever owning B's blocks."""
+        service = self.block_services.get((shm_ns, tenant or ""))
+        if service is None and tenant:
+            service = self.block_services.get((shm_ns, ""))
+        return service
+
+    def _effective_owner(  # guarded-by: self.lock|self.actor_state_cond held
+        self, owner: str, shm_ns: str, handoff: bool, tenant: str = ""
+    ) -> str:
+        """The owner of record for a new registration: the (namespace,
+        tenant)'s LIVE block service when the writer flagged the entry for
+        handoff, else the writer itself. Deciding HERE (the head knows actor
+        liveness authoritatively) means a dead/bouncing service degrades
+        registrations to executor ownership instead of parking blocks on a
+        corpse owner that no death event will ever GC."""
         if not handoff:
             return owner
-        service = self.block_services.get(shm_ns)
+        service = self._service_for(shm_ns, tenant)
         if service is None or service == owner:
             return owner
         actor = self.actors.get(service)
@@ -880,17 +921,134 @@ class Head:
         obs_metrics.counter("block_service.adopted_blocks").inc()
         return service
 
+    # ---------- tenant table (raydp_tpu.tenancy) ----------
+
+    def handle_tenant_register(
+        self, name: str, weight: float = 1.0, max_block_bytes: int = 0,
+    ):
+        """Admit a named tenant (one ``init_etl(app_name=...)`` attach).
+        Rejects a duplicate ACTIVE registration — the cross-driver half of
+        the session-singleton guard; re-registering a stopped tenant keeps
+        its accumulated byte accounting (blocks can outlive a session via
+        ownership transfer)."""
+        with self.lock:
+            record = self.tenants.get(name)
+            if record is not None and record.get("active"):
+                raise ClusterError(
+                    f"tenant {name!r} is already running on this cluster; "
+                    "stop it (or pick another app_name) first"
+                )
+            if record is None:
+                record = {"name": name, "bytes_stored": 0, "blocks": 0}
+                self.tenants[name] = record
+            record.update(
+                active=True,
+                weight=float(weight),
+                max_block_bytes=int(max_block_bytes),
+            )
+            # the gauge exists from registration on, so dump_metrics carries
+            # the per-tenant key even before the first block lands (pinned-
+            # schema tests and dashboards rely on the keys existing)
+            self._tenant_gauge(record).set(record["bytes_stored"])
+        obs_instant("tenant.registered", tenant=name)
+        obs_metrics.counter("tenant.registrations").inc()
+        return name
+
+    def handle_tenant_unregister(self, name: str):
+        """Mark a tenant inactive (its session stopped). The record — and
+        its byte accounting — survives: transferred blocks may outlive the
+        session, and a later re-attach under the same name resumes it."""
+        with self.lock:
+            record = self.tenants.get(name)
+            if record is not None:
+                record["active"] = False
+        obs_instant("tenant.unregistered", tenant=name)
+        return record is not None
+
+    def handle_tenant_list(self):
+        with self.lock:
+            return {
+                name: {k: v for k, v in r.items() if not k.startswith("_")}
+                for name, r in self.tenants.items()
+            }
+
+    @staticmethod
+    def _tenant_gauge(record: dict):  # guarded-by: self.lock|self.actor_state_cond held
+        """The tenant's bytes_stored gauge, cached ON the record: the
+        charge/credit paths run per block under the head lock (a wide
+        shuffle batch registers thousands of entries in one hold) and must
+        not pay an f-string build + registry-locked lookup each time."""
+        gauge = record.get("_gauge")
+        if gauge is None:
+            gauge = record["_gauge"] = obs_metrics.gauge(
+                f"tenant.{record['name']}.bytes_stored"
+            )
+        return gauge
+
+    def _tenant_record(self, tenant: str) -> Optional[dict]:  # guarded-by: self.lock|self.actor_state_cond held
+        if not tenant:
+            return None
+        record = self.tenants.get(tenant)
+        if record is None:
+            # unregistered writer (transferred survivors, out-of-band
+            # tools): account passively, enforce nothing
+            record = {
+                "name": tenant, "bytes_stored": 0, "blocks": 0,
+                "active": False, "weight": 1.0, "max_block_bytes": 0,
+            }
+            self.tenants[tenant] = record
+        return record
+
+    def _tenant_charge(  # guarded-by: self.lock|self.actor_state_cond held
+        self, object_id: str, size: int, enforce: bool = True
+    ) -> None:
+        """Charge a registration against its tenant's block-bytes quota
+        BEFORE inserting the meta; raises the typed quota error instead of
+        admitting the block (the writer's registration fails cleanly and
+        its segment is unlinked by the seal/batch failure paths).
+        ``enforce=False`` moves accounting without the quota check — the
+        rebind path, which re-registers bytes that were ALREADY admitted
+        (a quota raise there would drop the popped meta mid-recovery)."""
+        record = self._tenant_record(tenant_of_object(object_id))
+        if record is None:
+            return
+        limit = int(record.get("max_block_bytes") or 0) if enforce else 0
+        if limit and record["bytes_stored"] + size > limit:
+            obs_metrics.counter(
+                f"tenant.{record['name']}.quota_rejections"
+            ).inc()
+            err = TenantQuotaError(
+                f"tenant {record['name']!r} block-bytes quota exceeded: "
+                f"{record['bytes_stored']} stored + {size} new > {limit}"
+            )
+            err.tenant = record["name"]
+            raise err
+        record["bytes_stored"] += size
+        record["blocks"] += 1
+        self._tenant_gauge(record).set(record["bytes_stored"])
+
+    def _tenant_credit(self, meta: "_ObjectMeta") -> None:  # guarded-by: self.lock|self.actor_state_cond held
+        record = self.tenants.get(tenant_of_object(meta.object_id))
+        if record is None:
+            return
+        record["bytes_stored"] = max(0, record["bytes_stored"] - meta.size)
+        record["blocks"] = max(0, record["blocks"] - 1)
+        self._tenant_gauge(record).set(record["bytes_stored"])
+
     # ---------- object ownership table ----------
 
     def handle_object_put(
         self, object_id: str, owner: str, shm_name: str, size: int,
         node_id: str, shm_ns: str = "", handoff: bool = False,
     ):
-        """Register one block. Returns the EFFECTIVE owner (the namespace's
-        block service for handoff entries) so the writer can correct its
-        location cache and the metas it pushes to peers."""
+        """Register one block. Returns the EFFECTIVE owner (the writing
+        tenant's block service for handoff entries) so the writer can
+        correct its location cache and the metas it pushes to peers."""
         with self.lock:
-            owner = self._effective_owner(owner, shm_ns, handoff)
+            self._tenant_charge(object_id, size)
+            owner = self._effective_owner(
+                owner, shm_ns, handoff, tenant_of_object(object_id)
+            )
             self.objects[object_id] = _ObjectMeta(
                 object_id, owner, shm_name, size, node_id, shm_ns
             )
@@ -957,15 +1115,22 @@ class Head:
             spill_dir=os.path.join(self.session_dir, "spill"),
             storage=storage,
         )
-        with self.lock:
-            # registered as a DRIVER block, exactly like a put from a local
-            # driver: readable everywhere (object_lookup's fetch_addr falls
-            # back to the head, which holds the bytes), and invisible to
-            # locality-aware dispatch — proxied source blocks must not pin
-            # every consumer task onto the head node
-            self.objects[object_id] = _ObjectMeta(
-                object_id, owner, shm_name, len(payload), "driver", ""
-            )
+        try:
+            with self.lock:
+                self._tenant_charge(object_id, len(payload))
+                # registered as a DRIVER block, exactly like a put from a
+                # local driver: readable everywhere (object_lookup's
+                # fetch_addr falls back to the head, which holds the bytes),
+                # and invisible to locality-aware dispatch — proxied source
+                # blocks must not pin every consumer task onto the head node
+                self.objects[object_id] = _ObjectMeta(
+                    object_id, owner, shm_name, len(payload), "driver", ""
+                )
+        except TenantQuotaError:
+            # the bytes were already hosted: an over-quota rejection must
+            # not leak the just-written segment on the head node
+            self._unlink_shm(shm_name)
+            raise
         return True
 
     def _meta_view(self, object_id: str, meta: "_ObjectMeta") -> dict:  # guarded-by: self.lock|self.actor_state_cond held
@@ -994,7 +1159,9 @@ class Head:
         # readers can pull from the first-class owner (TCP only — same-host
         # readers map shm directly and never fetch). fetch_addr stays the
         # agent/head fallback for the service's restart window.
-        if meta.owner == self.block_services.get(meta.shm_ns):
+        if meta.owner == self._service_for(
+            meta.shm_ns, tenant_of_object(meta.object_id)
+        ):
             actor = self.actors.get(meta.owner)
             if (
                 actor is not None
@@ -1025,8 +1192,14 @@ class Head:
         reassigned: Dict[str, str] = {}
         with self.lock:
             for e in entries:
+                # quota check first: a mid-batch rejection leaves earlier
+                # entries registered — the writer's batched_registration
+                # failure path deletes the whole batch through the head,
+                # which credits them back
+                self._tenant_charge(e["object_id"], e["size"])
                 owner = self._effective_owner(
-                    e["owner"], e.get("shm_ns", ""), bool(e.get("handoff"))
+                    e["owner"], e.get("shm_ns", ""), bool(e.get("handoff")),
+                    tenant_of_object(e["object_id"]),
                 )
                 if owner != e["owner"]:
                     reassigned[e["object_id"]] = owner
@@ -1115,6 +1288,8 @@ class Head:
                 for object_id in object_ids
                 if (meta := self.objects.pop(object_id, None)) is not None
             ]
+            for meta in metas:
+                self._tenant_credit(meta)
             for object_id in object_ids:
                 # deleting a tombstoned id makes later reads a clean
                 # not-found (deliberate deletion), not OwnerDiedError
@@ -1138,6 +1313,12 @@ class Head:
                 meta = self.objects.pop(new_id, None)
                 if meta is None:
                     continue
+                # accounting moves with the id: credit the regenerated id,
+                # charge the original UNENFORCED (these bytes were already
+                # admitted at registration; a re-attach that shrank the
+                # quota below live bytes must not make recovery drop the
+                # popped meta mid-loop)
+                self._tenant_credit(meta)
                 live = self.objects.get(old_id)
                 if live is not None and not live.owner_died:
                     # duplicate recovery: another recoverer already rebound
@@ -1149,6 +1330,7 @@ class Head:
                     duplicates.append(meta)
                     rebound += 1
                     continue
+                self._tenant_charge(old_id, meta.size, enforce=False)
                 meta.object_id = old_id
                 self.objects[old_id] = meta
                 self.owner_tombstones.pop(old_id, None)
@@ -1271,6 +1453,7 @@ class Head:
                 # table forever) and tombstone the id so reads keep raising
                 # OwnerDiedError until a lineage rebind revives it
                 del self.objects[meta.object_id]
+                self._tenant_credit(meta)
                 self._tombstone(meta.object_id, owner)
         if dead:
             obs_metrics.counter("head.objects_unregistered").inc(len(dead))

@@ -62,6 +62,15 @@ class EtlExecutor:
             str(self.configs.get("store.block_service", "true")).lower()
             in ("1", "true", "yes")
         )
+        # tenant block namespace (raydp_tpu.tenancy): an executor belongs to
+        # exactly one session, so every block this PROCESS writes mints a
+        # tenant-prefixed object id — head-side accounting/quota and the
+        # per-tenant GC/block-service keying follow from the id alone.
+        # Empty (tenancy off / pre-tenancy session) = unprefixed ids,
+        # byte-identical to the old behavior.
+        _store.set_tenant_namespace(
+            str(self.configs.get("tenancy.namespace", "") or "")
+        )
         self._warm_up()
 
     def _pool(self):

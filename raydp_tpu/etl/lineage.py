@@ -47,8 +47,11 @@ class RecoveryError(ClusterError):
     a divergent result). Carries the original read error as ``__cause__``."""
 
 
-# object ids are uuid4().hex[:16] (store.new_object_id)
-_OBJECT_ID_RE = re.compile(r"\b[0-9a-f]{16}\b")
+# object ids are uuid4().hex[:16], optionally carrying a tenant-namespace
+# prefix "<tenant>." (store.new_object_id, docs/multitenancy.md) — the
+# string-fallback extraction must keep the prefix or recovery would probe
+# ids that don't exist
+_OBJECT_ID_RE = re.compile(r"\b(?:[A-Za-z0-9_-]+\.)?[0-9a-f]{16}\b")
 
 # substrings of the store/head error messages that mean "the block's bytes
 # are gone" (as opposed to an application error inside a task body)
@@ -377,6 +380,19 @@ def recover_blocks(planner, object_ids: Sequence[str], depth: int = 0) -> int:
         recovered += len(mapping)
         obs.metrics.counter("lineage.reexecuted_tasks").inc()
         obs.metrics.counter("lineage.recovered_blocks").inc(len(mapping))
+        tenant = getattr(planner, "tenant", "") or ""
+        if tenant:
+            # tenant-scoped attribution (docs/multitenancy.md): concurrent
+            # queries from DIFFERENT tenants share one driver process, so
+            # per-query recovery stats delta these instead of the global
+            # counters — tenant A's recovery must never show up in tenant
+            # B's last_query_stats
+            obs.metrics.counter(
+                f"tenant.{tenant}.lineage_reexecuted_tasks"
+            ).inc()
+            obs.metrics.counter(
+                f"tenant.{tenant}.lineage_recovered_blocks"
+            ).inc(len(mapping))
         obs.instant(
             "lineage.recovered",
             blocks=len(mapping),

@@ -127,6 +127,18 @@ class Planner:
         from raydp_tpu.sanitize import named_lock
 
         self._inflight_lock = named_lock("planner.inflight")
+        # multi-tenant plane (raydp_tpu.tenancy, docs/multitenancy.md):
+        #   admission — the session's fair-share AdmissionHandle; every
+        #     dispatch path acquires a ticket for its stage width before
+        #     touching the pool (None = tenancy off, zero overhead)
+        #   tenant — this session's tenant namespace; threads the block-id
+        #     prefix (store.tenant_scope) around each query's writes
+        #   shared_plan_cache — probe/publish the process-wide fingerprint-
+        #     keyed program cache so identical queries from different
+        #     tenants compile once (plan_cache.cross_tenant_hits)
+        self.admission = None
+        self.tenant = ""
+        self.shared_plan_cache = False
 
     def __getstate__(self):
         # planners travel inside pickled sessions (Dataset._session → workers);
@@ -138,6 +150,8 @@ class Planner:
         state.pop("scale_hook", None)
         state.pop("_inflight_lock", None)
         state["_inflight"] = 0
+        # the admission handle wraps the driver's process-local scheduler
+        state.pop("admission", None)
         # the compiled-plan cache and its delivery bookkeeping are process-
         # private (programs pin wire blobs; shipped-state is per connection)
         state.pop("_plan_cache", None)
@@ -166,6 +180,9 @@ class Planner:
         self.__dict__.setdefault("lineage_recovery", True)
         self.__dict__.setdefault("recovery_budget", 64)
         self.__dict__.setdefault("recovery_max_depth", 3)
+        self.admission = None
+        self.__dict__.setdefault("tenant", "")
+        self.__dict__.setdefault("shared_plan_cache", False)
         from raydp_tpu.etl import lineage as _lineage
 
         self.lineage = _lineage.LineageRegistry()
@@ -292,6 +309,13 @@ class Planner:
         from raydp_tpu import obs
 
         prefs: List[Optional[int]] = []
+        # fair-share admission (tenancy/scheduler.py): a ticket for this
+        # stage's width, BEFORE any executor sees a task — the weighted-DRR
+        # queue is what keeps one tenant's wide shuffle from starving a
+        # co-tenant's interactive stages. Re-entrant per thread (nested
+        # stages ride the outer ticket); None when tenancy is off.
+        admission = getattr(self, "admission", None)
+        ticket = admission.acquire(len(specs)) if admission is not None else None
         hook = self.scale_hook
         if hook is not None:
             with self._inflight_lock:
@@ -338,6 +362,8 @@ class Planner:
             self._record_lineage(specs, results)
             return results
         finally:
+            if admission is not None:
+                admission.release(ticket)
             if hook is not None:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -524,6 +550,12 @@ class Planner:
         failover ladder."""
         from raydp_tpu import obs
 
+        # admission note: the eager dispatches happened INSIDE the map
+        # stage's gather loop, under the map stage's ticket (the launcher
+        # runs on that thread) — this ticket accounts the reduce round's
+        # occupancy from here on, and is a no-op on that same thread
+        admission = getattr(self, "admission", None)
+        ticket = admission.acquire(len(specs)) if admission is not None else None
         hook = self.scale_hook
         if hook is not None:
             with self._inflight_lock:
@@ -544,6 +576,8 @@ class Planner:
             self._record_lineage(specs, results)
             return results
         finally:
+            if admission is not None:
+                admission.release(ticket)
             if hook is not None:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -980,16 +1014,33 @@ class Planner:
         # their deltas — documented; the counters themselves stay exact)
         _PC = ("hits", "misses", "unsupported")
         _RC = ("reexecuted_tasks", "recovered_blocks")
+        # recovery attribution: a tenant-scoped planner deltas ITS tenant's
+        # lineage counters, not the process-global ones — concurrent queries
+        # from different tenants share this process, and tenant A's recovery
+        # must never appear in tenant B's stats (docs/multitenancy.md)
+        tenant = getattr(self, "tenant", "") or ""
+        _rc_name = (
+            (lambda k: f"tenant.{tenant}.lineage_{k}") if tenant
+            else (lambda k: f"lineage.{k}")
+        )
         before = {
             "head_rpcs": obs.metrics.counter("rpc.client.calls").value,
             "dispatches": obs.metrics.counter("etl.actor_dispatches").value,
             "bypass": obs.metrics.counter("rpc.head_bypass_hits").value,
             **{k: obs.metrics.counter(f"plan_cache.{k}").value for k in _PC},
-            **{k: obs.metrics.counter(f"lineage.{k}").value for k in _RC},
+            **{k: obs.metrics.counter(_rc_name(k)).value for k in _RC},
         }
         try:
-            with obs.collect() as records, obs.span("etl.query") as query_span:
-                results = run()
+            # tenant block namespace (docs/multitenancy.md): every block
+            # this query writes driver-side mints a tenant-prefixed id, so
+            # head accounting/quota and the per-tenant GC keying hold for
+            # local-mode and driver-materialized stages too (executor-side
+            # writes carry the prefix via the executor's process default)
+            with store.tenant_scope(getattr(self, "tenant", "") or ""):
+                with obs.collect() as records, obs.span(
+                    "etl.query"
+                ) as query_span:
+                    results = run()
         finally:
             self._tls.query_active = False
         plan_cache = {
@@ -1003,7 +1054,7 @@ class Planner:
             # lineage activity this query paid for: re-executed producing
             # tasks and blocks rebound under their original ids (both 0 on
             # the happy path — the perf gate holds lineage ~free)
-            k: int(obs.metrics.counter(f"lineage.{k}").value - before[k])
+            k: int(obs.metrics.counter(_rc_name(k)).value - before[k])
             for k in _RC
         }
         rpc_stats = {
@@ -1347,6 +1398,12 @@ class Planner:
         waves = -(
             -(len(map_specs) + num_reducers) // max(1, self.executor_slots)
         )
+        admission = getattr(self, "admission", None)
+        ticket = (
+            admission.acquire(len(map_specs) + num_reducers)
+            if admission is not None
+            else None
+        )
         if hook is not None:
             # the inflight guard keeps dynamic deallocation from killing
             # this executor under the in-flight fused dispatch
@@ -1408,6 +1465,8 @@ class Planner:
                         ),
                     )
         finally:
+            if admission is not None:
+                admission.release(ticket)
             if hook is not None:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -1918,6 +1977,35 @@ class Planner:
             else:
                 obs.metrics.counter("plan_cache.hits").inc()
                 program = entry
+        if (
+            program is None
+            and self.plan_cache
+            and getattr(self, "shared_plan_cache", False)
+        ):
+            # cross-tenant shared cache (tenancy): another planner in this
+            # driver may have lowered this exact fingerprint already —
+            # adopt its program (counted as a hit; cross-tenant adoption
+            # additionally counts plan_cache.cross_tenant_hits, AFTER the
+            # template check so a rejected probe never fakes sharing) and
+            # seed the local LRU so the next probe is one dict hit
+            my_tenant = getattr(self, "tenant", "") or ""
+            entry2 = P.shared_plan_get(key.fingerprint, my_tenant)
+            if entry2 is not None:
+                shared, compiled_by = entry2
+                if not (
+                    shared.template_literals is not None
+                    and [lit.value for lit in key.literals]
+                    != shared.template_literals
+                ):
+                    obs.metrics.counter("plan_cache.hits").inc()
+                    if compiled_by != my_tenant:
+                        P.note_cross_tenant_hit(my_tenant)
+                    with self._plan_cache_lock:
+                        self._plan_cache[key.fingerprint] = shared
+                        self._plan_cache.move_to_end(key.fingerprint)
+                        while len(self._plan_cache) > self.PLAN_CACHE_CAP:
+                            self._plan_cache.popitem(last=False)
+                    program = shared
         if program is None:
             program = self._compile_plan(node, output, key)
             if self.plan_cache:
@@ -1932,6 +2020,11 @@ class Planner:
                 obs.metrics.counter("plan_cache.unsupported").inc()
                 return None
             obs.metrics.counter("plan_cache.misses").inc()
+            if self.plan_cache and getattr(self, "shared_plan_cache", False):
+                P.shared_plan_put(
+                    key.fingerprint, program,
+                    getattr(self, "tenant", "") or "",
+                )
         return self._run_program(program, key, output)
 
     def _compile_plan(self, node: lp.PlanNode, output: T.OutputSpec, key):
@@ -2234,6 +2327,8 @@ class Planner:
                 program, {**binding, "reads": reads, "indices": indices}
             )
             return self.submit(specs)
+        admission = getattr(self, "admission", None)
+        ticket = admission.acquire(len(reads)) if admission is not None else None
         hook = self.scale_hook
         if hook is not None:
             with self._inflight_lock:
@@ -2325,6 +2420,8 @@ class Planner:
             obs.metrics.counter("etl.compiled_dispatches").inc()
             return results  # type: ignore[return-value]
         finally:
+            if admission is not None:
+                admission.release(ticket)
             if hook is not None:
                 with self._inflight_lock:
                     self._inflight -= 1
@@ -2360,6 +2457,12 @@ class Planner:
             if len(self.executors) != 1:
                 return None  # pool grew: fused single-dispatch no longer applies
         b = {**binding, "reads": reads, "indices": list(range(len(reads)))}
+        admission = getattr(self, "admission", None)
+        ticket = (
+            admission.acquire(len(reads) + program.num_reducers)
+            if admission is not None
+            else None
+        )
         if hook is not None:
             with self._inflight_lock:
                 self._inflight += 1
@@ -2398,6 +2501,8 @@ class Planner:
                         ),
                     )
         finally:
+            if admission is not None:
+                admission.release(ticket)
             if hook is not None:
                 with self._inflight_lock:
                     self._inflight -= 1

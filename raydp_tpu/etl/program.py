@@ -444,3 +444,70 @@ def execute_program(
         reduce_spec(r, reads[r]) for r in range(program.num_reducers)
     ]
     return map_results, fanout(reduce_specs)
+
+
+# ---------------------------------------------------------------------------
+# cross-tenant shared plan cache (raydp_tpu.tenancy, docs/multitenancy.md)
+#
+# Compiled programs are already keyed by plan FINGERPRINT — nothing about a
+# program binds it to the session that compiled it (block refs and literals
+# are parameter slots; the output owner rides the per-query binding). This
+# process-wide LRU therefore lets every planner in the driver share one
+# compile: identical feature queries from different tenants (the
+# dashboards-everywhere workload) lower ONCE, and the executor-resident
+# cache sees one program id no matter which tenant ships it. Entries are
+# tagged with the compiling tenant so a hit from a DIFFERENT tenant is
+# counted (``plan_cache.cross_tenant_hits`` — the bench/perf-smoke
+# evidence). Probed only by planners with ``shared_plan_cache`` on (the
+# tenancy arm); the per-planner LRU in front of it is unchanged.
+# ---------------------------------------------------------------------------
+
+import collections as _collections
+import threading as _threading
+
+from raydp_tpu.sanitize import named_lock as _named_lock
+
+SHARED_PLAN_CACHE_CAP = 128
+_shared_plan_lock = _named_lock("tenancy.plan_cache", _threading.Lock())
+_shared_plans: "_collections.OrderedDict" = _collections.OrderedDict()  # fingerprint -> (program, tenant); guarded-by: _shared_plan_lock
+
+
+def shared_plan_get(fingerprint: str, tenant: str):
+    """``(program, compiled_by_tenant)`` for a fingerprint, or None. The
+    CALLER counts the cross-tenant hit — and only after actually adopting
+    the program (a template-literal mismatch rejects it post-probe, and a
+    counted-but-unused probe would fake the sharing evidence the
+    perf-smoke gate exists for)."""
+    with _shared_plan_lock:
+        entry = _shared_plans.get(fingerprint)
+        if entry is None:
+            return None
+        _shared_plans.move_to_end(fingerprint)
+        return entry
+
+
+def note_cross_tenant_hit(tenant: str) -> None:
+    """Record one ADOPTED cross-tenant shared-plan hit."""
+    from raydp_tpu.obs import metrics
+
+    metrics.counter("plan_cache.cross_tenant_hits").inc()
+    if tenant:
+        metrics.counter(f"tenant.{tenant}.plan_cache_cross_hits").inc()
+
+
+def shared_plan_put(fingerprint: str, program, tenant: str) -> None:
+    """Publish a freshly compiled program under its fingerprint, tagged with
+    the compiling tenant (first compiler wins the tag — a recompile race
+    must not flip attribution under a concurrent reader)."""
+    with _shared_plan_lock:
+        if fingerprint not in _shared_plans:
+            _shared_plans[fingerprint] = (program, tenant or "")
+        _shared_plans.move_to_end(fingerprint)
+        while len(_shared_plans) > SHARED_PLAN_CACHE_CAP:
+            _shared_plans.popitem(last=False)
+
+
+def shared_plan_clear() -> None:
+    """Drop every shared program (tests)."""
+    with _shared_plan_lock:
+        _shared_plans.clear()

@@ -60,12 +60,35 @@ class EtlSession:
         placement_group_strategy: Optional[str] = None,
         placement_group: Optional[cluster.PlacementGroup] = None,
         placement_group_bundle_indexes: Optional[List[int]] = None,
+        _co_tenants: int = 0,
     ):
         self.app_name = app_name
         self.num_executors = num_executors
         self.executor_cores = executor_cores
         self.executor_memory = parse_memory_size(executor_memory)
         self.configs = dict(configs or {})
+        # multi-tenant plane (raydp_tpu.tenancy, docs/multitenancy.md):
+        # ``tenancy.enabled`` (default ON) makes this session a named TENANT
+        # of the cluster — tenant-prefixed block ids, head tenant-table
+        # registration, fair-share dispatch admission, shared plan cache.
+        # OFF restores the pre-tenancy single-session behavior byte-for-byte
+        # (the A/B parity arm). ``_co_tenants`` is init_etl's count of other
+        # live sessions on this driver: >0 selects the explicit-attach
+        # capacity path below.
+        self._tenancy_enabled = str(
+            self.configs.get("tenancy.enabled", "true")
+        ).lower() in ("1", "true", "yes")
+        from raydp_tpu.tenancy import registry as _treg
+
+        self.tenant_ns = (
+            _treg.tenant_namespace(app_name) if self._tenancy_enabled else ""
+        )
+        self._admission = None
+        self._attach_node_id = None  # explicit-attach capacity, retired at stop
+        if self.tenant_ns:
+            # threaded to every executor/service process this session spawns
+            # (their whole process writes under this tenant's namespace)
+            self.configs["tenancy.namespace"] = self.tenant_ns
         # executors parallelize batched run_tasks calls with this many
         # threads (the per-task dispatch path gets the same width from the
         # actor's max_concurrency pool)
@@ -96,9 +119,27 @@ class EtlSession:
                 num_cpus=max(float(os.cpu_count() or 1), cpus_needed),
                 memory=max(4 << 30, memory_needed),
             )
+        elif _co_tenants > 0:
+            # EXPLICIT attach semantics (tenancy): other tenants are LIVE on
+            # this cluster, so free capacity is not ours to assume — add a
+            # logical node holding this tenant's FULL requested quota. The
+            # first tenant's executors are never resized or killed, and this
+            # tenant never schedules into capacity a co-tenant's elastic
+            # scale-out is about to claim. (Resources are logical, as at
+            # init: the reference CI similarly over-subscribes small hosts.)
+            # Remembered for stop(): the node retires with the tenant (when
+            # empty), so attach/stop cycles don't inflate the resource table.
+            self._attach_node_id = cluster.add_node(
+                {
+                    "CPU": max(1.0, cpus_needed),
+                    "memory": max(float(1 << 30), float(memory_needed)),
+                }
+            )
         else:
-            # an existing cluster may be sized for a smaller earlier session;
-            # grow it with an extra logical node rather than failing to place
+            # an existing cluster may be sized for a smaller earlier session
+            # (sequential re-attach — no live co-tenant): grow it by the
+            # DEFICIT with an extra logical node rather than failing to
+            # place, exactly the pre-tenancy behavior
             totals = cluster.total_resources()
             total_cpu = sum(r.get("CPU", 0.0) for r in totals.values())
             total_mem = sum(r.get("memory", 0.0) for r in totals.values())
@@ -109,6 +150,32 @@ class EtlSession:
                         "memory": max(float(1 << 30), memory_needed - total_mem),
                     }
                 )
+        if self.tenant_ns:
+            # named-tenant admission at the head BEFORE any actor spawns: a
+            # duplicate ACTIVE tenant (this driver or another) rejects here
+            # with nothing to roll back. Quota conf:
+            #   tenancy.weight            — fair-share DRR weight
+            #   tenancy.max_block_bytes   — head-enforced stored-bytes cap
+            #     (0 = unlimited); rejects with TenantQuotaError, typed
+            try:
+                cluster.head_rpc(
+                    "tenant_register",
+                    name=self.tenant_ns,
+                    weight=float(self.configs.get("tenancy.weight", 1.0)),
+                    max_block_bytes=int(
+                        self.configs.get("tenancy.max_block_bytes", 0)
+                    ),
+                )
+            except ClusterError as exc:
+                if "already running" in str(exc):
+                    raise RuntimeError(str(exc)) from exc
+                # an OLDER head (no tenant table) degrades to untracked
+                # single-tenant behavior instead of failing the session
+                if "unknown head method" not in str(exc):
+                    raise
+                self.tenant_ns = ""
+                self._tenancy_enabled = False
+                self.configs.pop("tenancy.namespace", None)
 
         # placement group pre-creation (parity: _prepare_placement_group,
         # reference context.py:94-113)
@@ -227,7 +294,12 @@ class EtlSession:
 
                 try:
                     self.block_service.wait_ready()
-                    _bs.register_service(self.block_service._actor_id)
+                    # tenant-scoped ownership: this service adopts ONLY this
+                    # tenant's handoffs, so its death at stop_etl can never
+                    # tombstone a co-tenant's blocks (docs/multitenancy.md)
+                    _bs.register_service(
+                        self.block_service._actor_id, tenant=self.tenant_ns
+                    )
                 except Exception:
                     # no service, no handoff: the head falls back to
                     # executor ownership and lineage covers losses (the
@@ -291,6 +363,40 @@ class EtlSession:
         self._planner.recovery_max_depth = int(
             self.configs.get("planner.recovery_max_depth", 3)
         )
+        # multi-tenant wiring (raydp_tpu.tenancy, docs/multitenancy.md):
+        #   tenancy.fair_share        (default on) — fair-share dispatch
+        #     admission: every stage acquires a DRR ticket sized to its
+        #     width; per-tenant in-flight/queue quotas reject typed
+        #   tenancy.shared_plan_cache (default on) — identical plan
+        #     fingerprints from different tenants reuse one compiled
+        #     program (plan_cache.cross_tenant_hits)
+        #   tenancy.max_inflight_tasks / tenancy.max_queued_requests /
+        #   tenancy.admission_timeout_s / tenancy.weight — scheduler knobs
+        self._planner.tenant = self.tenant_ns
+        if self.tenant_ns:
+            self._planner.shared_plan_cache = _flag("tenancy.shared_plan_cache")
+            if _flag("tenancy.fair_share"):
+                from raydp_tpu.tenancy import registry as _treg2
+
+                sched = _treg2.scheduler()
+                sched.register(
+                    self.tenant_ns,
+                    weight=float(self.configs.get("tenancy.weight", 1.0)),
+                    max_inflight=int(
+                        self.configs.get(
+                            "tenancy.max_inflight_tasks",
+                            max(8, num_executors * executor_cores * 8),
+                        )
+                    ),
+                    max_queued=int(
+                        self.configs.get("tenancy.max_queued_requests", 64)
+                    ),
+                    timeout_s=float(
+                        self.configs.get("tenancy.admission_timeout_s", 300.0)
+                    ),
+                )
+                self._admission = sched.handle(self.tenant_ns)
+                self._planner.admission = self._admission
         from raydp_tpu.store import object_store as _store
 
         _store.set_location_cache(self._planner.head_bypass)
@@ -387,7 +493,9 @@ class EtlSession:
         n = max(1, min(n, max(1, table.num_rows)))
         per = -(-table.num_rows // n)
         blocks = []
-        with store.batched_registration():
+        # tenant scope: driver-written source blocks mint tenant-prefixed
+        # ids too, so accounting/quota and per-tenant GC keying cover them
+        with store.tenant_scope(self.tenant_ns), store.batched_registration():
             for i in range(n):
                 chunk = table.slice(i * per, per)
                 ref, _ = write_table_block(chunk)
@@ -448,11 +556,20 @@ class EtlSession:
         state = dict(self.__dict__)
         state.pop("_dealloc_stop", None)
         state["_dyn_enabled"] = False
+        # the admission handle wraps this driver's process-local scheduler
+        # (thread-locals + locks): a shipped session dispatches unthrottled
+        state["_admission"] = None
         return state
 
     def __setstate__(self, state):
         self.__dict__.update(state)
         self._dealloc_stop = threading.Event()
+        self.__dict__.setdefault("_admission", None)
+        self.__dict__.setdefault("tenant_ns", "")
+        self.__dict__.setdefault("_tenancy_enabled", False)
+        # a SHIPPED session must never retire cluster capacity: the driver
+        # that created it owns the attach node's lifecycle
+        self._attach_node_id = None
 
     def _on_stage_width(self, num_tasks: int) -> None:
         """Scale-up half of dynamic allocation: called by the planner before
@@ -719,11 +836,26 @@ class EtlSession:
         transferred to it survive the session — the reference's
         ``stop_spark(cleanup_data=False)`` semantics (context.py:223-231,
         test_data_owner_transfer.py:79-123)."""
-        global _active_session
         if self._stopped:
             return
         self._stopped = True
         self._dealloc_stop.set()
+        # tenancy teardown FIRST: parked admissions wake (they fail fast
+        # against the dying pool instead of waiting out their timeout) and
+        # the head frees the tenant name for a later re-attach. Only THIS
+        # tenant's scheduler state and tenant record are touched — a
+        # co-tenant's dispatches, blocks, and accounting are invisible here.
+        if self.tenant_ns:
+            try:
+                from raydp_tpu.tenancy import registry as _treg
+
+                _treg.scheduler().unregister(self.tenant_ns)
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (scheduler teardown is driver-local bookkeeping; the kill path below must always run)
+                pass
+            try:
+                cluster.head_rpc("tenant_unregister", name=self.tenant_ns)
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (head may already be down at teardown; the tenant record is advisory once the session died)
+                pass
         killed = list(self.executors)
         # the block service dies WITH the session (intentional kill): the
         # ownership contract — non-transferred data dies at stop
@@ -774,6 +906,23 @@ class EtlSession:
             except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown races placement-group removal)
                 pass
             self._pg = None
+        attach_node = getattr(self, "_attach_node_id", None)
+        if attach_node is not None:
+            # retire the attach-capacity node with its tenant — but ONLY if
+            # empty: a co-tenant's actor scheduled onto it must never be
+            # collateral of this session's stop (the head declines then and
+            # the node lingers as plain spare capacity, the lesser evil)
+            try:
+                cluster.head_rpc(
+                    "remove_node", node_id=attach_node, only_if_empty=True
+                )
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (head may already be down at teardown; a phantom logical node is harmless then)
+                pass
+            self._attach_node_id = None
+        from raydp_tpu.tenancy import registry as _treg3
+
+        _treg3.discard_session(self)
+        global _active_session
         with _lock:
             if _active_session is self:
                 _active_session = None
@@ -823,15 +972,36 @@ def init_etl(
     placement_group: Optional[cluster.PlacementGroup] = None,
     placement_group_bundle_indexes: Optional[List[int]] = None,
 ) -> EtlSession:
-    """Start (or return) the singleton ETL session — ``raydp.init_spark``
-    parity (reference context.py:154-231), including the double-init guard."""
+    """Start a session — ``raydp.init_spark`` parity (reference
+    context.py:154-231). With the multi-tenant plane on (``tenancy.enabled``
+    conf, default ON — docs/multitenancy.md) a second ``init_etl`` under a
+    NEW app name ATTACHES to the running cluster as a named tenant at its
+    requested quota (the reference's named-app-on-a-shared-Ray-cluster
+    shape); the same name, or any session with tenancy off, keeps the
+    init_spark singleton guard and raises."""
     global _active_session
+    from raydp_tpu.tenancy import registry as _treg
+
     with _lock:
-        if _active_session is not None and not _active_session._stopped:
-            raise RuntimeError(
-                "an ETL session is already running; call stop_etl() first "
-                "(parity: init_spark singleton guard, reference context.py:129-147)"
-            )
+        tenancy_on = str(
+            (configs or {}).get("tenancy.enabled", "true")
+        ).lower() in ("1", "true", "yes")
+        live = _treg.sessions()
+        if live:
+            legacy = any(not s._tenancy_enabled for s in live)
+            if not tenancy_on or legacy:
+                raise RuntimeError(
+                    "an ETL session is already running; call stop_etl() first "
+                    "(parity: init_spark singleton guard, reference "
+                    "context.py:129-147; concurrent tenants need "
+                    "tenancy.enabled on every session)"
+                )
+            ns = _treg.tenant_namespace(app_name)
+            if any(s.tenant_ns == ns for s in live):
+                raise RuntimeError(
+                    f"tenant {ns!r} is already running on this cluster; "
+                    "stop it (or pick another app_name) first"
+                )
         # operator overrides from raydp-tpu-submit win over application args
         # (spark-submit --conf precedence, reference bin/raydp-submit)
         from raydp_tpu.submit import submitted_overrides
@@ -842,35 +1012,72 @@ def init_etl(
         executor_memory = overrides.get("executor_memory", executor_memory)
         if overrides.get("configs"):
             configs = {**(configs or {}), **overrides["configs"]}
-        session = EtlSession(
-            app_name,
-            num_executors,
-            executor_cores,
-            executor_memory,
-            configs=configs,
-            placement_group_strategy=placement_group_strategy,
-            placement_group=placement_group,
-            placement_group_bundle_indexes=placement_group_bundle_indexes,
-        )
+        try:
+            session = EtlSession(
+                app_name,
+                num_executors,
+                executor_cores,
+                executor_memory,
+                configs=configs,
+                placement_group_strategy=placement_group_strategy,
+                placement_group=placement_group,
+                placement_group_bundle_indexes=placement_group_bundle_indexes,
+                _co_tenants=len(live),
+            )
+        except BaseException as exc:
+            # roll back the head's tenant registration when construction
+            # failed AFTER it (spawn failure, readiness timeout): otherwise
+            # the name stays ACTIVE with no session to stop and every retry
+            # is rejected until the head restarts. The duplicate-rejection
+            # path must NOT unregister — that record belongs to the LIVE
+            # tenant (possibly another driver's) this init collided with.
+            if tenancy_on and not (
+                isinstance(exc, RuntimeError) and "already running" in str(exc)
+            ):
+                try:
+                    # raydp-lint: disable=blocking-under-lock (deliberate:
+                    # the session lock serializes init/stop BY DESIGN — the
+                    # whole EtlSession construction above blocks under it —
+                    # and this bounded rollback RPC runs only on the
+                    # construction-failure path; releasing first would let a
+                    # concurrent init of the same name race the unregister)
+                    cluster.head_rpc(
+                        "tenant_unregister",
+                        name=_treg.tenant_namespace(app_name),
+                    )
+                except Exception:  # raydp-lint: disable=swallowed-exceptions (rollback is best-effort; the original construction error is what the caller needs)
+                    pass
+            raise
+        _treg.add_session(session)
         _active_session = session
         atexit.register(_atexit_stop)
         return session
 
 
 def _atexit_stop() -> None:
-    with _lock:
-        if _active_session is not None:
-            _active_session.stop()
+    # every still-live tenant stops (multi-session: one atexit sweep)
+    from raydp_tpu.tenancy import registry as _treg
+
+    for session in _treg.sessions():
+        session.stop()
 
 
 def stop_etl(cleanup_data: bool = True, del_obj_holder: bool = True) -> None:
-    with _lock:
-        if _active_session is not None:
-            _active_session.stop(cleanup_data=cleanup_data, del_obj_holder=del_obj_holder)
+    """Stop the CURRENT session: this thread's (``tenancy.use_session`` /
+    the thread that created it), else the most recently created live one —
+    the single-session behavior unchanged. Co-tenants keep running; stop
+    them via their own ``session.stop()`` or this function on their
+    thread."""
+    session = active_session()
+    if session is not None:
+        session.stop(cleanup_data=cleanup_data, del_obj_holder=del_obj_holder)
 
 
 def active_session() -> Optional[EtlSession]:
-    """The running session from init_etl, or None once stopped/absent."""
-    if _active_session is not None and not _active_session._stopped:
-        return _active_session
-    return None
+    """The running session bound to THIS thread (the thread that created it
+    or a ``tenancy.use_session`` scope), falling back to the most recently
+    created live session — which is exactly the old singleton contract when
+    one session exists. None once stopped/absent."""
+    from raydp_tpu.tenancy import registry as _treg
+
+    return _treg.current_session()

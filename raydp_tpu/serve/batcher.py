@@ -82,10 +82,17 @@ class DynamicBatcher:
         conf,
         feature_columns=None,
         on_replica_failure: Optional[Callable] = None,
+        admission=None,
     ):
         self._conf = conf
         self._feature_columns = feature_columns
         self._on_replica_failure = on_replica_failure
+        # fair-share admission (tenancy/scheduler.py): when the deployment
+        # names a tenant (``serve.tenant`` conf), every batch dispatch
+        # acquires one admission ticket from the SAME weighted-DRR queue the
+        # tenant's ETL stages use — serving and ETL share one quota, and a
+        # co-tenant cannot starve this deployment. None = unthrottled.
+        self._admission = admission
         self._cond = threading.Condition(
             sanitize.named_lock("serve.queue", threading.Lock())
         )
@@ -376,6 +383,32 @@ class DynamicBatcher:
             for req in batch:
                 req.fail(exc)
             return
+        ticket = None
+        if self._admission is not None:
+            from raydp_tpu.tenancy.scheduler import TenantQuotaError
+
+            try:
+                # bounded by the request timeout: a tenant parked behind a
+                # co-tenant's backlog is backpressure (the dispatcher thread
+                # waits, requests fill the admission queue); a wait that
+                # outlives the request budget resolves the TYPED quota
+                # error to the callers instead of wedging the queue
+                ticket = self._admission.acquire(
+                    1, timeout_s=conf.request_timeout_s
+                )
+            except TenantQuotaError as exc:
+                self._m_errors.inc()
+                for req in batch:
+                    req.fail(exc)
+                return
+        try:
+            self._dispatch_to_replica(batch, n, padded)
+        finally:
+            if self._admission is not None:
+                self._admission.release(ticket)
+
+    def _dispatch_to_replica(self, batch: List[_Request], n: int, padded) -> None:
+        conf = self._conf
         handle = self._pick_replica()
         if handle is None:
             # no live replica RIGHT NOW (all draining/failed — the

@@ -63,6 +63,13 @@ class ServeConf:
     # -- replicas -------------------------------------------------------
     replica_light: bool = True  # zygote warm fork (python -S); see docs
     replica_max_concurrency: int = 4
+    # -- tenancy (docs/multitenancy.md) ---------------------------------
+    # name a tenant and this deployment's batch dispatches ride the same
+    # fair-share admission queue as that tenant's ETL stages — serving and
+    # ETL traffic from one tenant share one quota, and a co-tenant's heavy
+    # shuffle cannot starve this deployment's batches (or vice versa).
+    # Empty = unthrottled, the single-tenant behavior.
+    tenant: str = ""
     extra: dict = field(default_factory=dict)
 
     @classmethod
@@ -112,6 +119,7 @@ class ServeConf:
             replica_max_concurrency=max(
                 2, int(get("replica_max_concurrency", 4))
             ),
+            tenant=str(get("tenant", "") or ""),
             extra=merged,
         )
         return out

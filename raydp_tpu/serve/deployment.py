@@ -65,10 +65,21 @@ class Deployment:
         self._m_reloads = obs.metrics.counter("serve.reloads")
         self._m_failovers = obs.metrics.counter("serve.replica_replacements")
         self._g_replicas = obs.metrics.gauge("serve.replicas")
+        admission = None
+        if conf.tenant:
+            # ride the named tenant's fair-share queue (docs/multitenancy.md);
+            # a serve-only tenant (no ETL session) registers with defaults
+            from raydp_tpu.tenancy import registry as _treg
+
+            scheduler = _treg.scheduler()
+            if conf.tenant not in scheduler.snapshot():
+                scheduler.register(conf.tenant)
+            admission = scheduler.handle(conf.tenant)
         self.batcher = DynamicBatcher(
             conf,
             feature_columns=feature_columns,
             on_replica_failure=self._on_replica_failure,
+            admission=admission,
         )
         try:
             with obs.span(

@@ -117,21 +117,29 @@ def service_block_fetch(
     raise value
 
 
-def service_for_namespace(shm_ns: str = "") -> Optional[str]:
+def service_for_namespace(shm_ns: str = "", tenant: str = "") -> Optional[str]:
     """The actor id of the block service registered for a shared-memory
-    namespace (None when that host runs without one — registrations there
-    keep executor ownership and rely on lineage, the PR 8 behavior)."""
+    namespace — the ``tenant``-scoped entry first, the namespace's tenant-
+    less fallback second (None when that host runs without one —
+    registrations there keep executor ownership and rely on lineage, the
+    PR 8 behavior)."""
     from raydp_tpu.cluster import api as cluster_api
 
-    return cluster_api.head_rpc("block_service_lookup", shm_ns=shm_ns)
+    return cluster_api.head_rpc(
+        "block_service_lookup", shm_ns=shm_ns, tenant=tenant
+    )
 
 
-def register_service(actor_id: str) -> str:
+def register_service(actor_id: str, tenant: str = "") -> str:
     """Record a spawned BlockService actor as its node namespace's owner of
-    record at the head; returns the namespace it now serves."""
+    record at the head (scoped to ``tenant`` when given, so one session's
+    service never adopts — or tombstones, at stop — another tenant's
+    blocks); returns the namespace it now serves."""
     from raydp_tpu.cluster import api as cluster_api
 
-    return cluster_api.head_rpc("block_service_register", actor_id=actor_id)
+    return cluster_api.head_rpc(
+        "block_service_register", actor_id=actor_id, tenant=tenant
+    )
 
 
 def deregister_service(actor_id: str) -> bool:

@@ -588,8 +588,69 @@ class batched_registration:
         return False
 
 
+# ---------------------------------------------------------------------------
+# tenant block namespaces (raydp_tpu.tenancy, docs/multitenancy.md)
+#
+# Object ids minted by a tenant-scoped writer carry the tenant namespace as
+# an id prefix (``<tenant>.<hex16>``): the head attributes bytes/quota per
+# tenant from the id alone, lineage records / tombstones / deletion records
+# are per-tenant by construction (they are keyed by id), and the block-
+# service owner table keys on (shm namespace, tenant) so one tenant's stop
+# can never adopt or GC another tenant's blocks. Two scopes compose:
+#
+# - the PROCESS default (``set_tenant_namespace``) — executors belong to
+#   exactly one session, so their whole process writes under that tenant;
+# - a THREAD overlay (``tenant_scope``) — the driver hosts many sessions,
+#   so each query/conversion wraps its writes in the owning session's scope.
+#
+# Default empty: unprefixed ids, zero behavior change (the tenancy-off A/B
+# arm and every pre-tenancy process).
+# ---------------------------------------------------------------------------
+
+_default_tenant_ns = ""
+_tenant_tls = threading.local()
+
+
+def set_tenant_namespace(ns: str) -> None:
+    """Process-default tenant namespace for newly minted object ids
+    (executors set this from their session configs at spawn)."""
+    global _default_tenant_ns
+    _default_tenant_ns = ns or ""
+
+
+class tenant_scope:
+    """Thread-scoped tenant namespace overlay (driver-side: one process
+    hosts many sessions, so each query's writes ride the owning session's
+    scope). Nests; restores the previous overlay on exit."""
+
+    def __init__(self, ns: str):
+        self._ns = ns or ""
+        self._prev: Optional[str] = None
+
+    def __enter__(self) -> "tenant_scope":
+        self._prev = getattr(_tenant_tls, "ns", None)
+        _tenant_tls.ns = self._ns
+        return self
+
+    def __exit__(self, *exc) -> None:
+        if self._prev is None:
+            _tenant_tls.ns = ""
+            del _tenant_tls.ns
+        else:
+            _tenant_tls.ns = self._prev
+
+
+def current_tenant_namespace() -> str:
+    ns = getattr(_tenant_tls, "ns", None)
+    if ns:
+        return ns
+    return _default_tenant_ns
+
+
 def new_object_id() -> str:
-    return uuid.uuid4().hex[:16]
+    ns = current_tenant_namespace()
+    suffix = uuid.uuid4().hex[:16]
+    return f"{ns}.{suffix}" if ns else suffix
 
 
 # ---------------------------------------------------------------------------

@@ -1,0 +1,57 @@
+"""``raydp_tpu.tenancy`` — the multi-tenant control plane.
+
+One cluster, many concurrent sessions (docs/multitenancy.md):
+
+- **session registry** — ``init_etl(app_name=...)`` ATTACHES to a running
+  cluster as a named tenant (head ``tenant_register/unregister/list`` ops)
+  instead of erroring; ``active_session()`` is per-thread with this
+  package's explicit multi-session API (:func:`sessions`,
+  :func:`use_session`, :func:`list_tenants`);
+- **per-tenant block namespaces** — object ids carry the tenant as a
+  prefix, so head-side accounting, lineage records, tombstones, deletion
+  records, and the block-service owner table are all tenant-keyed: one
+  tenant's ``stop_etl`` can never GC or tombstone another's blocks;
+- **fair-share dispatch** (:mod:`raydp_tpu.tenancy.scheduler`) — a weighted
+  deficit-round-robin admission queue in front of every executor-dispatch
+  path, with per-tenant in-flight quotas and typed over-quota rejection
+  (:class:`TenantQuotaError`);
+- **cross-tenant plan-cache sharing** — compiled programs are keyed by plan
+  fingerprint, so identical queries from different tenants reuse one
+  lowered program (``plan_cache.cross_tenant_hits``);
+- **per-tenant accounting** — ``tenant.<ns>.*`` metrics in
+  ``dump_metrics()`` (bytes stored, tasks dispatched, queue wait, quota
+  rejections).
+
+``tenancy.enabled`` session conf (default ON); OFF restores the
+single-session singleton byte-for-byte (the A/B parity arm).
+"""
+
+from raydp_tpu.cluster.common import TenantQuotaError
+from raydp_tpu.tenancy.registry import (
+    current_session,
+    list_tenants,
+    reset_scheduler,
+    scheduler,
+    sessions,
+    tenant_namespace,
+    use_session,
+)
+from raydp_tpu.tenancy.scheduler import (
+    AdmissionHandle,
+    FairShareScheduler,
+    Ticket,
+)
+
+__all__ = [
+    "TenantQuotaError",
+    "AdmissionHandle",
+    "FairShareScheduler",
+    "Ticket",
+    "current_session",
+    "list_tenants",
+    "scheduler",
+    "reset_scheduler",
+    "sessions",
+    "tenant_namespace",
+    "use_session",
+]

@@ -67,7 +67,10 @@ def test_executor_blocks_are_service_owned(session):
     ds = _materialized(session)
     service_id = session.block_service._actor_id
     assert {store.owner_of(b) for b in ds.blocks} == {service_id}
-    assert bs.service_for_namespace("") == service_id
+    # the owner-kind table is (namespace, tenant)-keyed: the session's
+    # service serves ITS tenant, and no tenant-less fallback exists for it
+    assert bs.service_for_namespace("", tenant=session.tenant_ns) == service_id
+    assert bs.service_for_namespace("") is None
     # the writer's pushed metas / caches carry the EFFECTIVE owner too:
     # a read-warmed cached location must name the service, not an executor
     assert T.read_table_block(ds.blocks[0]).num_rows > 0

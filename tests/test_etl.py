@@ -236,8 +236,14 @@ def test_repartition_hash_coherence(session):
 
 
 def test_init_twice_guard(session):
+    # same tenant name: rejected (the per-name half of the singleton guard)
     with pytest.raises(RuntimeError, match="already running"):
-        raydp_tpu.init_etl("second")
+        raydp_tpu.init_etl(session.app_name)
+    # tenancy off: the legacy init_spark singleton guard — ANY live session
+    # blocks a second init (a different app name included); with tenancy on
+    # a new name would attach as a second tenant instead (test_tenancy.py)
+    with pytest.raises(RuntimeError, match="already running"):
+        raydp_tpu.init_etl("second", configs={"tenancy.enabled": "false"})
 
 
 def test_select_by_expr_not_star(session):

@@ -1,0 +1,401 @@
+"""Multi-tenant control plane tests (raydp_tpu.tenancy, docs/multitenancy.md).
+
+Real multi-process sessions like the rest of the suite: two concurrent
+``init_etl`` tenants on ONE cluster, byte-identical results, namespace/GC
+isolation across ``stop_etl``, white-box fair-share (DRR) admission order,
+typed quota rejection, cross-tenant plan-cache sharing, per-tenant metric
+keys pinned, and the tenancy-off A/B arm.
+"""
+
+import threading
+import time
+
+import pytest
+
+import raydp_tpu
+from raydp_tpu import obs, tenancy
+from raydp_tpu.cluster.common import TenantQuotaError
+from raydp_tpu.etl import functions as F
+from raydp_tpu.exchange import dataframe_to_dataset
+from raydp_tpu.tenancy.scheduler import FairShareScheduler
+
+
+def _mk(name, executors=1, **configs):
+    return raydp_tpu.init_etl(
+        name, num_executors=executors, executor_cores=1,
+        executor_memory="300M", configs=configs or None,
+    )
+
+
+def _query(session, rows=6_000):
+    """One shuffle-bearing query (compiled-ineligible group_by path plus a
+    narrow chain) whose collect() is deterministic."""
+    df = (
+        session.range(rows, num_partitions=4)
+        .with_column("k", F.col("id") % 13)
+        .with_column("v", F.col("id") * 3)
+    )
+    return df.group_by("k").agg(F.sum("v").alias("s")).sort("k").collect()
+
+
+# ---------------------------------------------------------------------------
+# concurrent sessions on one cluster
+# ---------------------------------------------------------------------------
+
+
+def test_two_concurrent_sessions_byte_identical_to_solo():
+    """Two tenants' queries running CONCURRENTLY on one cluster return
+    exactly what each returns alone — fair-share admission and tenant
+    namespaces must never change results."""
+    solo = _mk("ten-solo")
+    try:
+        expected_a = _query(solo, rows=6_000)
+        expected_b = _query(solo, rows=4_000)
+    finally:
+        solo.stop()
+
+    a = _mk("ten-a")
+    b = _mk("ten-b")
+    try:
+        assert [s.app_name for s in tenancy.sessions()] == ["ten-a", "ten-b"]
+        out = {}
+
+        def run(key, session, rows):
+            with tenancy.use_session(session):
+                for _ in range(3):
+                    out[key] = _query(session, rows=rows)
+
+        ta = threading.Thread(target=run, args=("a", a, 6_000))
+        tb = threading.Thread(target=run, args=("b", b, 4_000))
+        ta.start(); tb.start(); ta.join(); tb.join()
+        assert out["a"] == expected_a
+        assert out["b"] == expected_b
+        tenants = tenancy.list_tenants()
+        assert tenants["ten-a"]["active"] and tenants["ten-b"]["active"]
+    finally:
+        b.stop()
+        a.stop()
+
+
+def test_stop_etl_of_one_tenant_leaves_other_tenants_blocks():
+    """Namespace isolation: tenant A's ``stop_etl(cleanup_data=True)``
+    (which kills A's executors, master, AND block service — tombstoning
+    every block THEY own) must leave tenant B's materialized blocks
+    readable and B's queries running."""
+    a = _mk("ten-gc-a")
+    b = _mk("ten-gc-b")
+    stopped_a = False
+    try:
+        ds_b = dataframe_to_dataset(
+            b.range(8_000, num_partitions=4).with_column(
+                "x", F.col("id") + 1
+            )
+        )
+        # A materializes too — its blocks must die with it, B's must not
+        ds_a = dataframe_to_dataset(
+            a.range(2_000, num_partitions=2).with_column("y", F.col("id"))
+        )
+        assert ds_b.count() == 8_000
+        with tenancy.use_session(a):
+            raydp_tpu.stop_etl(cleanup_data=True)
+        stopped_a = True
+        # B's blocks survive A's GC sweep, byte-for-byte
+        assert ds_b.to_arrow().num_rows == 8_000
+        assert ds_b.count() == 8_000
+        with tenancy.use_session(b):
+            assert _query(b, rows=3_000)  # B's dispatch plane still works
+        # and A's data really is gone (its owners died at stop)
+        with pytest.raises(Exception):
+            ds_a.to_arrow()
+    finally:
+        if not stopped_a:
+            a.stop()
+        b.stop()
+
+
+def test_second_tenant_attaches_without_resizing_first():
+    """Explicit attach semantics: a second tenant joins at its own quota —
+    the first tenant's executor pool is untouched (same live handles) and
+    the cluster GREW rather than re-slicing."""
+    from raydp_tpu.cluster import api as cluster
+    from raydp_tpu.cluster.common import ActorState
+
+    a = _mk("ten-att-a")
+    try:
+        before_ids = [h._actor_id for h in a.executors]
+        before_cpu = sum(
+            r.get("CPU", 0.0) for r in cluster.total_resources().values()
+        )
+        b = _mk("ten-att-b", executors=2)
+        try:
+            after_cpu = sum(
+                r.get("CPU", 0.0) for r in cluster.total_resources().values()
+            )
+            assert after_cpu > before_cpu  # capacity ADDED for B's quota
+            assert [h._actor_id for h in a.executors] == before_ids
+            assert all(
+                h.state() == ActorState.ALIVE for h in a.executors
+            )
+            assert len(b.executors) == 2
+            assert _query(a, rows=2_000) == _query(b, rows=2_000)
+        finally:
+            b.stop()
+    finally:
+        a.stop()
+
+
+def test_sequential_sessions_keep_legacy_behavior():
+    """The two-sessions-SEQUENTIAL case (init → stop → init) keeps today's
+    behavior: the second session reuses the cluster and runs normally."""
+    s1 = _mk("ten-seq-1")
+    r1 = _query(s1, rows=2_000)
+    s1.stop()
+    s2 = _mk("ten-seq-2")
+    try:
+        assert _query(s2, rows=2_000) == r1
+        assert raydp_tpu.etl.active_session() is s2
+    finally:
+        s2.stop()
+
+
+def test_active_session_is_per_thread():
+    a = _mk("ten-thr-a")
+    b = _mk("ten-thr-b")
+    try:
+        # creation thread: most recent wins the fallback
+        assert raydp_tpu.etl.active_session() is b
+        with tenancy.use_session(a):
+            assert raydp_tpu.etl.active_session() is a
+        seen = {}
+
+        def other_thread():
+            with tenancy.use_session(a):
+                seen["in"] = raydp_tpu.etl.active_session()
+            seen["out"] = raydp_tpu.etl.active_session()
+
+        t = threading.Thread(target=other_thread)
+        t.start(); t.join()
+        assert seen["in"] is a
+        assert seen["out"] is b  # fallback: most recent live session
+    finally:
+        b.stop()
+        a.stop()
+
+
+# ---------------------------------------------------------------------------
+# fair-share scheduler (white-box)
+# ---------------------------------------------------------------------------
+
+
+def test_drr_interactive_tenant_not_starved_by_saturating_tenant():
+    """White-box DRR order: with tenant A saturating its own in-flight
+    quota and a backlog queued, tenant B's cheap stage admits IMMEDIATELY
+    (next drain round) instead of waiting out A's backlog."""
+    sched = FairShareScheduler(record=True)
+    sched.register("A", max_inflight=4, max_queued=16)
+    sched.register("B", max_inflight=4, max_queued=16)
+    t_a0 = sched.acquire("A", 4)  # saturate A
+    backlog = []
+
+    def queue_a():
+        ticket = sched.acquire("A", 2)
+        backlog.append(ticket)
+        sched.release(ticket)
+
+    threads = [threading.Thread(target=queue_a) for _ in range(3)]
+    for t in threads:
+        t.start()
+    deadline = time.monotonic() + 5
+    while sched.snapshot()["A"]["queued"] < 3 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    assert sched.snapshot()["A"]["queued"] == 3
+    # B admits despite A's backlog — the fairness contract
+    t_b = sched.acquire("B", 2, timeout_s=5)
+    assert sched.admission_log()[-1] == ("B", 2)
+    sched.release(t_b)
+    # releasing A's saturating ticket drains A's backlog FIFO
+    sched.release(t_a0)
+    for t in threads:
+        t.join(timeout=10)
+    assert len(backlog) == 3
+    log = sched.admission_log()
+    assert log[0] == ("A", 4)
+    assert log.count(("A", 2)) == 3
+    assert sched.snapshot()["A"]["inflight"] == 0
+
+
+def test_oversized_stage_admits_at_full_quota():
+    """A stage wider than the tenant's whole quota clamps to a full-quota
+    ticket (it alone saturates the tenant) instead of deadlocking."""
+    sched = FairShareScheduler()
+    sched.register("wide", max_inflight=8)
+    ticket = sched.acquire("wide", 1000)
+    assert ticket.cost == 8
+    assert sched.snapshot()["wide"]["inflight"] == 8
+    sched.release(ticket)
+
+
+def test_scheduler_quota_rejection_typed():
+    """Over-quota admission rejects with the TYPED error — queue-full
+    immediately, sustained wait at the timeout — never a wedged queue."""
+    sched = FairShareScheduler()
+    sched.register("q", max_inflight=2, max_queued=1, timeout_s=0.4)
+    saturating = sched.acquire("q", 2)
+    parked = []
+
+    def park():
+        try:
+            parked.append(sched.acquire("q", 1, timeout_s=10))
+        except TenantQuotaError:
+            parked.append(None)
+
+    t = threading.Thread(target=park)
+    t.start()
+    deadline = time.monotonic() + 5
+    while sched.snapshot()["q"]["queued"] < 1 and time.monotonic() < deadline:
+        time.sleep(0.01)
+    # queue full (max_queued=1): reject-fast
+    with pytest.raises(TenantQuotaError) as exc:
+        sched.acquire("q", 1)
+    assert exc.value.tenant == "q"
+    # timeout path (separate tenant with queue room): a bounded wait that
+    # cannot be served rejects typed instead of parking forever
+    sched.register("t", max_inflight=2, max_queued=8)
+    hold = sched.acquire("t", 2)
+    with pytest.raises(TenantQuotaError):
+        sched.acquire("t", 1, timeout_s=0.2)
+    sched.release(hold)
+    sched.release(saturating)
+    t.join(timeout=10)
+    assert parked and parked[0] is not None
+    sched.release(parked[0])
+
+
+def test_head_block_bytes_quota_rejects_typed():
+    """The head-enforced stored-bytes quota: a tenant writing past
+    ``tenancy.max_block_bytes`` gets TenantQuotaError (typed, attributable)
+    and the cluster keeps serving the tenant's other work."""
+    import pandas as pd
+
+    s = _mk("ten-quota", **{"tenancy.max_block_bytes": 4096})
+    try:
+        big = pd.DataFrame({"x": range(200_000)})
+        with pytest.raises(TenantQuotaError) as exc:
+            s.from_pandas(big, num_partitions=2)
+        assert exc.value.tenant == "ten-quota"
+        # not wedged: small writes under the quota still work
+        small = s.from_pandas(pd.DataFrame({"x": [1, 2, 3]}), num_partitions=1)
+        assert small.count() == 3
+        record = tenancy.list_tenants()["ten-quota"]
+        assert 0 < record["bytes_stored"] <= 4096
+    finally:
+        s.stop()
+
+
+# ---------------------------------------------------------------------------
+# cross-tenant plan-cache sharing
+# ---------------------------------------------------------------------------
+
+
+def test_cross_tenant_plan_cache_hit_counted():
+    """Identical plan fingerprints from two tenants reuse ONE compiled
+    program: tenant B's first execution of A's query shape is a plan-cache
+    HIT, counted as a cross-tenant hit — and byte-identical to A's."""
+    a = _mk("ten-pc-a")
+    b = _mk("ten-pc-b")
+    try:
+        def shape(session):
+            df = session.range(5_000, num_partitions=2).with_column(
+                "x", F.col("id") * 2
+            )
+            return df.filter(F.col("x") % 7 == 0).collect()
+
+        result_a = shape(a)
+        before_hits = obs.metrics.counter("plan_cache.cross_tenant_hits").value
+        with tenancy.use_session(b):
+            result_b = shape(b)
+            stats = b.last_query_stats
+        assert result_b == result_a
+        assert stats["plan_cache"]["hits"] >= 1
+        assert stats["plan_cache"]["misses"] == 0, stats["plan_cache"]
+        delta = (
+            obs.metrics.counter("plan_cache.cross_tenant_hits").value
+            - before_hits
+        )
+        assert delta >= 1
+        assert (
+            obs.metrics.counter("tenant.ten-pc-b.plan_cache_cross_hits").value
+            >= 1
+        )
+    finally:
+        b.stop()
+        a.stop()
+
+
+# ---------------------------------------------------------------------------
+# per-tenant accounting / A-B parity
+# ---------------------------------------------------------------------------
+
+
+def test_per_tenant_metric_keys_pinned_in_dump_metrics():
+    """The per-tenant observability surface (docs/observability.md): the
+    scheduler's driver-side instruments and the head's bytes gauge exist —
+    zero-valued or not — the moment a tenant registers."""
+    s = _mk("ten-metrics")
+    try:
+        ds = dataframe_to_dataset(
+            s.range(4_000, num_partitions=2).with_column("z", F.col("id"))
+        )
+        assert ds.count() == 4_000
+        ns = s.tenant_ns
+        merged = raydp_tpu.dump_metrics()
+        driver_key = next(k for k in merged if k.startswith("driver:"))
+        driver = merged[driver_key]
+        for key in (
+            f"tenant.{ns}.tasks_dispatched",
+            f"tenant.{ns}.queue_wait_s",
+            f"tenant.{ns}.quota_rejections",
+            f"tenant.{ns}.queue_depth",
+        ):
+            assert key in driver, key
+        assert driver[f"tenant.{ns}.tasks_dispatched"]["value"] >= 1
+        head_key = next(k for k in merged if k.startswith("head:"))
+        assert f"tenant.{ns}.bytes_stored" in merged[head_key]
+        # head-side live accounting agrees: the materialized dataset's
+        # bytes are charged to this tenant
+        record = tenancy.list_tenants()[ns]
+        assert record["bytes_stored"] > 0
+        assert record["blocks"] >= 2
+    finally:
+        s.stop()
+
+
+def test_tenancy_off_ab_byte_identical():
+    """The A/B parity arm: ``tenancy.enabled=false`` restores the
+    pre-tenancy single-session behavior — unprefixed block ids, no tenant
+    registration, no admission — and results are byte-identical to the
+    tenancy-on arm."""
+    off = _mk("ten-ab", **{"tenancy.enabled": "false"})
+    try:
+        assert off.tenant_ns == ""
+        assert off._planner.admission is None
+        ds = dataframe_to_dataset(
+            off.range(1_000, num_partitions=2).with_column("w", F.col("id"))
+        )
+        # unprefixed ids: the pre-tenancy wire format, byte-for-byte
+        assert all("." not in b.object_id for b in ds.blocks)
+        result_off = _query(off, rows=3_000)
+    finally:
+        off.stop()
+    on = _mk("ten-ab-on")
+    try:
+        assert on.tenant_ns == "ten-ab-on"
+        ds = dataframe_to_dataset(
+            on.range(1_000, num_partitions=2).with_column("w", F.col("id"))
+        )
+        assert all(
+            b.object_id.startswith("ten-ab-on.") for b in ds.blocks
+        )
+        assert _query(on, rows=3_000) == result_off
+    finally:
+        on.stop()

@@ -38,10 +38,17 @@ responses byte-identical to an unkilled run (the deployment pins a single
 batch bucket — XLA numerics are bit-stable per shape — and re-admitted
 requests are pure re-computation; docs/serving.md "Failover").
 
+The multi-tenant plane adds a fourth tier: ``tenant_kill_isolation`` runs
+two tenants on one cluster, SIGKILLs tenant A's block-holding executor
+mid-query, and gates tenant B's CONCURRENT query byte-identical with zero
+lineage re-execution charged to it — one tenant's failure (and recovery)
+must never leak into another's blocks, plans, or results
+(docs/multitenancy.md).
+
 ``--quick`` runs the CI slice (mid-shuffle + mid-fit lineage kills, both
-block-service tiers, and the replica kill); without it the full scenario
-list runs (adds the compiled-dispatch kill and the elasticity
-round-trip). ``--seed``
+block-service tiers, the tenant-isolation kill, and the replica kill);
+without it the full scenario list runs (adds the compiled-dispatch kill
+and the elasticity round-trip). ``--seed``
 makes victim/timing selection deterministic (unseeded runs keep the fixed
 legacy choices). Exit code is non-zero when any query went unrecovered or
 any sanitizer finding surfaced. The same scenario bodies are reused by
@@ -716,11 +723,113 @@ def scenario_replica_kill_during_load(n_requests: int = 240) -> dict:
         raydp_tpu.stop_etl()
 
 
+def scenario_tenant_kill_isolation(rows: int = 60_000) -> dict:
+    """The multi-tenant blast-radius contract (docs/multitenancy.md): two
+    tenants share ONE cluster; tenant A's block-holding executor is
+    SIGKILLed mid-query (A runs the lineage arm, so the loss is real) while
+    tenant B's query runs CONCURRENTLY. Gates:
+
+    - B's result is BYTE-IDENTICAL to its clean run with
+      ``lineage.reexecuted_tasks == 0`` charged to B's query (the per-query
+      ``last_query_stats['recovery']`` record — A's recovery must never
+      touch B's blocks or plans);
+    - A (the victim tenant) recovers as usual: byte-identical via lineage
+      with ≥1 re-executed task.
+
+    Runs under the same strict sanitizers as every scenario."""
+    import raydp_tpu
+    from raydp_tpu import tenancy
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+
+    # tenant A on the lineage arm (executor-owned blocks = real loss);
+    # tenant B on the defaults. Both attach to one cluster as named tenants.
+    session_a = _fresh_session("chaos-ten-a", configs=dict(LINEAGE_ARM))
+    session_b = None
+    try:
+        session_b = raydp_tpu.init_etl(
+            "chaos-ten-b", num_executors=1, executor_cores=1,
+            executor_memory="300M",
+        )
+        src_a = session_a.range(rows, num_partitions=8).with_column(
+            "k", F.col("id") % 13
+        )
+        ds_a = dataframe_to_dataset(src_a)
+        df_a = dataset_to_dataframe(session_a, ds_a)
+        src_b = session_b.range(rows // 2, num_partitions=4).with_column(
+            "k", F.col("id") % 7
+        )
+        ds_b = dataframe_to_dataset(src_b)
+        df_b = dataset_to_dataframe(session_b, ds_b)
+        with tenancy.use_session(session_a):
+            clean_a = df_a.group_by("k").count().sort("k").collect()
+        with tenancy.use_session(session_b):
+            clean_b = df_b.group_by("k").count().sort("k").collect()
+
+        victim = block_owner_executor(session_a, ds_a)
+        kill_executor(session_a, handle=victim)
+        time.sleep(0.3)
+
+        b_out: dict = {}
+
+        def run_b():
+            with tenancy.use_session(session_b):
+                try:
+                    b_out["result"] = (
+                        df_b.group_by("k").count().sort("k").collect()
+                    )
+                    b_out["recovery"] = dict(
+                        session_b.last_query_stats.get("recovery", {})
+                    )
+                except Exception as exc:  # noqa: BLE001 - the gate reports it
+                    b_out["error"] = repr(exc)[:300]
+
+        thread_b = threading.Thread(target=run_b, name="tenant-b-query")
+        thread_b.start()
+        before = lineage_counters()
+        with tenancy.use_session(session_a):
+            chaos_a = df_a.group_by("k").count().sort("k").collect()
+        after = lineage_counters()
+        thread_b.join(timeout=120)
+        session_a.request_total_executors(2)
+
+        a_reexecuted = after["reexecuted_tasks"] - before["reexecuted_tasks"]
+        a_identical = chaos_a == clean_a
+        b_identical = b_out.get("result") == clean_b
+        b_reexecuted = int(
+            b_out.get("recovery", {}).get("reexecuted_tasks", -1)
+        )
+        ok = bool(
+            a_identical
+            and a_reexecuted >= 1
+            and b_identical
+            and b_reexecuted == 0
+            and "error" not in b_out
+        )
+        entry = {
+            "name": "tenant_kill_isolation",
+            "ok": ok,
+            "victim_tenant_byte_identical": bool(a_identical),
+            "victim_tenant_reexecuted_tasks": a_reexecuted,
+            "other_tenant_byte_identical": bool(b_identical),
+            # THE gate: the co-tenant's concurrent query pays ZERO recovery
+            "other_tenant_reexecuted_tasks": b_reexecuted,
+        }
+        if "error" in b_out:
+            entry["other_tenant_error"] = b_out["error"]
+        return entry
+    finally:
+        if session_b is not None:
+            session_b.stop()
+        session_a.stop()
+
+
 QUICK = (
     scenario_mid_shuffle,
     scenario_mid_fit,
     scenario_executor_kill_with_service,
     scenario_service_kill_lineage_fallback,
+    scenario_tenant_kill_isolation,
     scenario_replica_kill_during_load,
 )
 FULL = (
@@ -729,6 +838,7 @@ FULL = (
     scenario_mid_fit,
     scenario_executor_kill_with_service,
     scenario_service_kill_lineage_fallback,
+    scenario_tenant_kill_isolation,
     scenario_elasticity,
     scenario_replica_kill_during_load,
 )

@@ -45,7 +45,12 @@ when:
 - the serving kill-during-load probe failed zero-drop recovery: a replica
   SIGKILL mid-stream must drop ZERO requests, return responses
   byte-identical to an unkilled run, and the pool must heal to target
-  (docs/serving.md "Failover").
+  (docs/serving.md "Failover");
+- the tenant-isolation probe failed (docs/multitenancy.md): with a
+  co-tenant churning a heavy shuffle on the same cluster, the interactive
+  tenant's burst p99 must stay within 3x of its solo baseline, and at
+  least one cross-tenant plan-cache hit must be recorded (identical query
+  shapes from different tenants share one compiled program).
 
 Usage: ``python tools/perf_smoke.py [artifact.json]``
 """
@@ -126,6 +131,7 @@ def main() -> int:
         "streaming_ingest_probe": detail.get("streaming_ingest_probe", {}),
         "recovery_probe": detail.get("recovery_probe", {}),
         "serving_probe": detail.get("serving_probe", {}),
+        "tenant_isolation_probe": detail.get("tenant_isolation_probe", {}),
         "recovery_overhead": detail.get("recovery_overhead"),
         "etl_breakdown": detail.get("etl_breakdown", {}),
         "shuffle_probe": detail.get("shuffle_probe", {}),
@@ -217,6 +223,25 @@ def main() -> int:
             )
     else:
         failures.append("serving_probe missing from bench detail")
+    tenant = artifact["tenant_isolation_probe"]
+    if tenant:
+        ratio = tenant.get("p99_ratio")
+        if ratio is None or ratio > 3.0:
+            failures.append(
+                f"tenant-isolation p99 ratio {ratio} exceeds 3.0x (a noisy "
+                "co-tenant's shuffle moved the interactive tenant's p99 "
+                "beyond the bounded-interference budget)"
+            )
+        if int(tenant.get("cross_tenant_hits", 0)) < 1:
+            failures.append(
+                "no cross-tenant plan-cache hit recorded (identical query "
+                "shapes from different tenants must share one compiled "
+                "program)"
+            )
+        if not tenant.get("ok"):
+            failures.append(f"tenant-isolation probe failed: {tenant}")
+    else:
+        failures.append("tenant_isolation_probe missing from bench detail")
     for entry in artifact["shuffle_probe"].get("shuffle", []):
         if entry.get("indexed") and entry["blocks"] > entry["map_tasks"]:
             failures.append(
