@@ -625,6 +625,108 @@ def tenant_isolation_probe() -> dict:
         inter.stop()
 
 
+def obs_overhead_probe() -> dict:
+    """Telemetry-on vs telemetry-off cost of the warm compiled-query path,
+    plus scrape-endpoint liveness (ISSUE 14; perf_smoke gates both).
+
+    One session, one compiled query shape, interleaved rounds with rotating
+    lead (the r06 lesson: alternating A/B medians is what transfers on a
+    noisy 2-core box): each round runs the identical burst once with span
+    SHIPPING enabled (ring buffer + obs_ingest flushes + TSDB/flight feeds
+    — the always-on plane this PR adds) and once with it disabled
+    (collector-derived stats stay on in both arms, as they always are; the
+    session's executors keep their spawn-time tracing env in both arms, so
+    the delta isolates the driver-visible shipping cost). Reports
+    median-of-rounds p50s and their quotient.
+
+    Scrape liveness: one real scrape of the head endpoint must parse, carry
+    at least one ``tenant``-labeled series and at least one ``serve_``
+    series (the serving probe ran earlier in this process, so the driver's
+    registry carries the serve plane's counters to the head)."""
+    import raydp_tpu
+    from raydp_tpu import obs
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.obs import tracing as _tracing
+    from raydp_tpu.obs.timeseries import parse_prometheus_text, scrape
+
+    n_queries = int(os.environ.get("BENCH_OBS_BURST", 120))
+    rounds = int(os.environ.get("BENCH_OBS_ROUNDS", 4))
+    session = raydp_tpu.init_etl(
+        "bench-obs", num_executors=1, executor_cores=1,
+        executor_memory="500M", configs={"obs.scrape_port": "auto"},
+    )
+    was_enabled = _tracing.enabled()
+    try:
+        df = session.range(100_000, num_partitions=2).with_column(
+            "x", F.col("id") * 3
+        )
+        q = df.filter(F.col("x") % 5 == 0)
+        q.count()  # compile + ship the program, warm the doorbell sockets
+
+        def one_burst() -> float:
+            lat = []
+            for _ in range(max(1, n_queries)):
+                t0 = time.perf_counter()
+                q.count()
+                lat.append((time.perf_counter() - t0) * 1000.0)
+            lat.sort()
+            return lat[len(lat) // 2]
+
+        p50_on, p50_off = [], []
+        for i in range(max(1, rounds)):
+            order = ((True, False), (False, True))[i % 2]  # rotating lead
+            for arm_on in order:
+                _tracing.set_enabled(arm_on)
+                p50 = one_burst()
+                (p50_on if arm_on else p50_off).append(p50)
+        _tracing.set_enabled(True)
+        p50_on.sort()
+        p50_off.sort()
+        on_ms = p50_on[len(p50_on) // 2]
+        off_ms = p50_off[len(p50_off) // 2]
+        overhead = on_ms / max(1e-9, off_ms) - 1.0
+
+        # scrape liveness: flush so this driver's registry (incl. the serve
+        # probe's counters and this tenant's series) is on the head
+        obs.flush()
+        scrape_report: dict = {"ok": False}
+        addr = session.scrape_addr
+        if addr:
+            try:
+                text = scrape(*addr)
+                parsed = parse_prometheus_text(text)
+                has_tenant = any(
+                    any(k == "tenant" for k, _ in labels)
+                    for series in parsed.values() for labels in series
+                )
+                has_serve = any(
+                    name.startswith("raydp_serve_") for name in parsed
+                )
+                scrape_report = {
+                    "ok": bool(parsed),
+                    "addr": list(addr),
+                    "series": len(parsed),
+                    "has_tenant_label": bool(has_tenant),
+                    "has_serve_series": bool(has_serve),
+                }
+            except Exception as exc:  # noqa: BLE001 - the gate reports it
+                scrape_report = {"ok": False, "error": repr(exc)[:200]}
+        return {
+            "burst_queries": n_queries,
+            "rounds": rounds,
+            "p50_on_ms": round(on_ms, 3),
+            "p50_off_ms": round(off_ms, 3),
+            "p50_on_samples": [round(v, 3) for v in p50_on],
+            "p50_off_samples": [round(v, 3) for v in p50_off],
+            "overhead_frac": round(overhead, 4),
+            "scrape": scrape_report,
+            "ok": bool(scrape_report.get("ok")),
+        }
+    finally:
+        _tracing.set_enabled(was_enabled)
+        session.stop()
+
+
 def _etl_breakdown(stats):
     """Compact, JSON-ready view of the planner's last_query_stats: per-stage
     task counts, dispatch mode, and the server-side read/compute/emit phase
@@ -1509,6 +1611,12 @@ def main():
     # after all training clocks
     tenant_probe = tenant_isolation_probe()
 
+    # telemetry-overhead probe (raydp_tpu.obs v2): identical compiled-query
+    # burst with span shipping on vs off (interleaved medians) + one real
+    # Prometheus scrape of the head endpoint — after the serving probe so
+    # the scrape can prove serve_* series liveness
+    obs_probe = obs_overhead_probe()
+
     # export the whole run's trace (driver + head + executors under the
     # propagated trace ids) and the merged metrics registries
     trace_path = os.environ.get("BENCH_TRACE_PATH", "bench_trace.json")
@@ -1541,6 +1649,7 @@ def main():
             "obs_metrics": obs_headline,
             "serving_probe": serving,
             "tenant_isolation_probe": tenant_probe,
+            "obs_overhead_probe": obs_probe,
             "dlrm": dlrm,
             "lm": bench_transformer_lm(),
             "parallel_steps": bench_parallel_steps(),

@@ -35,9 +35,11 @@ _LAZY = {
     # reference's ray://host:port analog)
     "connect_cluster": ("raydp_tpu.cluster.api", "connect_cluster"),
     # observability plane (raydp_tpu.obs): Perfetto trace export + merged
-    # cluster metrics
+    # cluster metrics + windowed time-series + critical-path attribution
     "export_trace": ("raydp_tpu.obs", "export_trace"),
     "dump_metrics": ("raydp_tpu.cluster.api", "dump_metrics"),
+    "query_metrics": ("raydp_tpu.cluster.api", "query_metrics"),
+    "explain_last_query": ("raydp_tpu.obs", "explain_last_query"),
     # online serving plane (docs/serving.md): attribute access resolves the
     # subpackage so `raydp_tpu.serve.deploy(...)` works without an explicit
     # `import raydp_tpu.serve`

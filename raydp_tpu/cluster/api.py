@@ -878,3 +878,39 @@ def export_trace(path: str) -> str:
     from raydp_tpu.obs.export import export_trace as _export
 
     return _export(path)
+
+
+def query_metrics(
+    name: str,
+    window_s: float = 60.0,
+    labels: Optional[Dict[str, str]] = None,
+    aggregate: bool = False,
+) -> Any:
+    """Windowed time-series read from the head's ring TSDB — the in-process
+    flavor of a Prometheus scrape (docs/observability.md "Time series").
+    Returns matching series (``[{name, labels, type, points, last,
+    delta?}]``) or, with ``aggregate=True``, one windowed aggregate
+    (``{series, delta, last, max}``). Flushes this process first so its own
+    registry is part of the answer; degrades to the process-local mirror
+    when no cluster is running."""
+    from raydp_tpu.obs import timeseries as _ts
+    from raydp_tpu.obs.tracing import flush
+
+    flush()  # best-effort: puts this process's snapshot on the head
+    try:
+        if is_initialized() or os.environ.get(SESSION_ENV):
+            return head_rpc(
+                "obs_query_series", name=name, window_s=window_s,
+                labels=labels, aggregate=aggregate, timeout=30.0,
+            )
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (no cluster (or dead head): the local mirror below still answers)
+        pass
+    if aggregate:
+        return _ts.local_store.windowed(name, window_s, labels)
+    return _ts.local_store.query(name, window_s, labels)
+
+
+def scrape_addr() -> Optional[tuple]:
+    """(host, port) of the head's Prometheus scrape endpoint, or None when
+    no session enabled it (``obs.scrape_port`` conf)."""
+    return head_rpc("obs_scrape_addr", timeout=10.0)

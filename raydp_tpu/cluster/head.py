@@ -14,6 +14,7 @@ never starve the control plane.
 
 from __future__ import annotations
 
+import itertools as _itertools
 import os
 import signal
 import socket
@@ -181,11 +182,30 @@ class Head:
         # truncated trace instead of unbounded head memory.
         import collections as _collections
 
+        # capacity: ``obs.head_ring_spans`` session conf (obs_configure op)
+        # with the legacy env var as the pre-conf fallback
         self.obs_spans: "_collections.deque" = _collections.deque(
             maxlen=int(os.environ.get("RAYDP_TPU_TRACE_HEAD_CAP", "200000"))
         )
         self.obs_dropped = 0
         self.obs_metrics: Dict[str, dict] = {}
+        # telemetry plane v2 (docs/observability.md): the ring TSDB behind
+        # the Prometheus scrape endpoint + query_metrics, and the flight
+        # recorder behind crash dossiers. Both have their own LEAF locks —
+        # fed after obs_ingest releases self.lock, read by the scrape
+        # thread / dossier writers without ever touching self.lock.
+        from raydp_tpu.obs.recorder import DOSSIER_DIR_ENV, FlightRecorder
+        from raydp_tpu.obs.timeseries import SeriesStore
+
+        self.tsdb = SeriesStore()
+        self.flight = FlightRecorder()
+        self.dossier_dir = os.environ.get(DOSSIER_DIR_ENV) or os.path.join(
+            session_dir, "dossiers"
+        )
+        self.scrape_server = None  # guarded-by: self._scrape_lock
+        self._scrape_lock = sanitize.named_lock(
+            "head.scrape", threading.Lock()
+        )
         if default_resources:
             self._add_node(default_resources)
 
@@ -808,6 +828,28 @@ class Head:
                 intentional=actor.intentional_exit,
             )
             obs_metrics.counter("cluster.actor_deaths").inc()
+            if not self.shutting_down:
+                # flight recorder: every terminal actor death (executor,
+                # replica, block service — SIGKILLed or crashed) gets a
+                # crash dossier with the victim's last shipped rings.
+                # Teardown kills are excluded by the shutting_down guard;
+                # the write runs on a detached thread (file I/O never under
+                # self.lock).
+                self._write_crash_dossier(
+                    reason=(
+                        "actor_killed" if actor.intentional_exit
+                        else "actor_crashed"
+                    ),
+                    victim={
+                        "actor_id": actor.spec.actor_id,
+                        "name": actor.spec.name,
+                        "pid": actor.proc.pid if actor.proc is not None else None,
+                        "intentional": actor.intentional_exit,
+                        "restarts_used": actor.restarts_used,
+                        "error": str(actor.error)[:300] if actor.error else None,
+                    },
+                    needle=actor.spec.actor_id,
+                )
             self.actor_state_cond.notify_all()
             self._on_owner_dead(actor.spec.actor_id)
             # a DEAD block service must not keep adopting registrations —
@@ -1466,11 +1508,17 @@ class Head:
     # ---------- observability (obs layer aggregation) ----------
 
     def handle_obs_ingest(
-        self, proc: dict, spans: List[dict], metrics_snapshot: dict
+        self, proc: dict, spans: List[dict], metrics_snapshot: dict,
+        logs: Optional[List[dict]] = None,
     ):
-        """A process flushed its span ring buffer + metrics registry here.
-        Metrics snapshots are cumulative per process — replace, keyed by
-        (role, pid); spans append into the bounded deque."""
+        """A process flushed its span ring buffer + metrics registry (+ its
+        flight-recorder log ring) here. Metrics snapshots are cumulative per
+        process — replace, keyed by (role, pid); spans append into the
+        bounded deque, with evictions counted PER ROLE
+        (``obs.ingest_evictions.<role>``) so a chatty role squeezing the
+        others out of the trace ring is visible in ``dump_metrics``."""
+        role = proc.get("role", "proc")
+        key = f"{role}:{proc.get('pid', 0)}"
         with self.lock:
             if spans:
                 overflow = (
@@ -1478,16 +1526,210 @@ class Head:
                 )
                 if overflow > 0:
                     self.obs_dropped += overflow
+                    # the evicted spans are the OLDEST resident entries (or,
+                    # past capacity, the head of the incoming batch): count
+                    # each against its own role so the victim is named
+                    evicted = list(
+                        _itertools.islice(self.obs_spans, 0, overflow)
+                    )
+                    if overflow > len(self.obs_spans):
+                        evicted.extend(spans[: overflow - len(evicted)])
+                    by_role: Dict[str, int] = {}
+                    for record in evicted:
+                        victim_role = str(
+                            record.get("proc", "proc")
+                        ).split(":", 1)[0]
+                        by_role[victim_role] = by_role.get(victim_role, 0) + 1
+                    for victim_role, count in by_role.items():
+                        obs_metrics.counter(
+                            f"obs.ingest_evictions.{victim_role}"
+                        ).inc(count)
                 self.obs_spans.extend(spans)
             if metrics_snapshot:
-                key = f"{proc.get('role', 'proc')}:{proc.get('pid', 0)}"
                 metrics_snapshot = dict(metrics_snapshot)
                 if proc.get("dropped"):
                     metrics_snapshot["trace.spans_dropped"] = {
                         "type": "counter", "value": proc["dropped"],
                     }
                 self.obs_metrics[key] = metrics_snapshot
+        # TSDB + flight recorder rides OUTSIDE self.lock: both have their
+        # own leaf locks, and neither belongs on the actor-table critical
+        # section (a scrape-sized ingest must not stall spawns)
+        if metrics_snapshot:
+            self.tsdb.ingest(key, role, metrics_snapshot)
+        self.flight.note_ingest(key, role, spans or [], metrics_snapshot, logs)
         return True
+
+    def handle_obs_configure(
+        self,
+        head_ring_spans: Optional[int] = None,
+        dossier_dir: Optional[str] = None,
+        scrape_port: Optional[int] = None,
+    ):
+        """Session-boot configuration of the telemetry plane (``obs.*``
+        confs, docs/observability.md): resize the head span ring, point the
+        dossier dir, and/or start the Prometheus scrape endpoint (idempotent
+        — a second session reuses the running server). Returns the live
+        settings including the bound scrape address."""
+        import collections as _collections
+
+        with self.lock:
+            if head_ring_spans is not None and int(head_ring_spans) > 0:
+                cap = int(head_ring_spans)
+                if cap != (self.obs_spans.maxlen or 0):
+                    self.obs_spans = _collections.deque(
+                        self.obs_spans, maxlen=cap
+                    )
+            if dossier_dir:
+                self.dossier_dir = str(dossier_dir)
+            ring_cap = self.obs_spans.maxlen
+            out_dir = self.dossier_dir
+        if scrape_port is not None:
+            addr = self._ensure_scrape_server(int(scrape_port))
+        else:
+            addr = self.handle_obs_scrape_addr()
+        return {
+            "head_ring_spans": ring_cap,
+            "dossier_dir": out_dir,
+            "scrape_addr": addr,
+        }
+
+    def _ensure_scrape_server(self, port: int):
+        """Start (or return) the scrape endpoint. Serialized by its own
+        LEAF lock (never self.lock — the bind is I/O), so two sessions
+        configuring at once cannot race a second live server into
+        existence: one server serves, every caller gets its address."""
+        with self._scrape_lock:
+            server = self.scrape_server
+            if server is None:
+                from raydp_tpu.obs.timeseries import ScrapeServer
+
+                server = self.scrape_server = ScrapeServer(
+                    self.tsdb, port=port
+                )
+                obs_log.info(
+                    "scrape endpoint up", host=server.host, port=server.port
+                )
+            return (server.host, server.port)
+
+    def handle_obs_scrape_addr(self):
+        with self._scrape_lock:
+            server = self.scrape_server
+            return (server.host, server.port) if server is not None else None
+
+    def close_scrape_server(self) -> None:
+        with self._scrape_lock:
+            server = self.scrape_server
+            self.scrape_server = None
+        if server is not None:
+            server.close()
+
+    def handle_obs_query_series(
+        self,
+        name,
+        window_s: float = 60.0,
+        labels: Optional[dict] = None,
+        aggregate: bool = False,
+    ):
+        """``cluster.query_metrics`` read side: matching series from the
+        head TSDB (or the windowed aggregate). ``name`` may be a LIST of
+        metric names — one round trip answers a whole signal group
+        (``tenancy.fair_share_series`` reads five in one RPC), returned as
+        ``{name: result}``."""
+        if isinstance(name, (list, tuple)):
+            return {
+                n: (
+                    self.tsdb.windowed(n, window_s, labels) if aggregate
+                    else self.tsdb.query(n, window_s, labels)
+                )
+                for n in name
+            }
+        if aggregate:
+            return self.tsdb.windowed(name, window_s, labels)
+        return self.tsdb.query(name, window_s, labels)
+
+    def handle_obs_dossier(
+        self, reason: str, victim: Optional[dict] = None,
+        needle: Optional[str] = None,
+    ):
+        """Driver-triggered dossier (unrecovered query, sanitizer finding):
+        assemble + write synchronously and return the path."""
+        head_state = self._dossier_head_state()
+        victim_keys = (
+            self.flight.find_victim_keys(needle) if needle
+            else self.flight.proc_keys()
+        )
+        dossier = self.flight.assemble(
+            reason, victim_keys=victim_keys, victim=victim,
+            head_state=head_state,
+        )
+        path = self.flight.write(dossier, self.dossier_dir)
+        if path:
+            obs_metrics.counter("obs.dossiers_written").inc()
+        return path
+
+    def _dossier_head_state(self) -> dict:
+        """Snapshot of the head's authoritative tables for a dossier —
+        cheap dict building only."""
+        with self.lock:
+            actors = [
+                {
+                    "actor_id": a.spec.actor_id,
+                    "name": a.spec.name,
+                    "state": str(a.state),
+                    "pid": a.proc.pid if a.proc is not None else None,
+                    "node": a.node_id,
+                    "incarnation": a.incarnation,
+                    "restarts_used": a.restarts_used,
+                    "intentional_exit": a.intentional_exit,
+                    "error": str(a.error)[:300] if a.error else None,
+                }
+                for a in self.actors.values()
+            ]
+            tenants = {
+                name: {
+                    k: v for k, v in record.items()
+                    if isinstance(v, (int, float, str, bool))
+                }
+                for name, record in self.tenants.items()
+            }
+            return {
+                "actors": actors,
+                "tenants": tenants,
+                "objects": len(self.objects),
+                "block_services": {
+                    f"{ns or '-'}::{tenant or '-'}": actor_id
+                    for (ns, tenant), actor_id in self.block_services.items()
+                },
+                "nodes": len(self.nodes),
+                "obs_ring": {
+                    "spans": len(self.obs_spans),
+                    "cap": self.obs_spans.maxlen,
+                    "dropped": self.obs_dropped,
+                },
+            }
+
+    def _write_crash_dossier(self, reason: str, victim: dict,
+                             needle: str) -> None:
+        """Assemble + write a dossier for one actor death on a DETACHED
+        thread: the caller holds self.lock (monitor/death paths) and the
+        write is file I/O."""
+        head_state = self._dossier_head_state()
+
+        def _write():
+            try:
+                dossier = self.flight.assemble(
+                    reason,
+                    victim_keys=self.flight.find_victim_keys(needle),
+                    victim=victim, head_state=head_state,
+                )
+                if self.flight.write(dossier, self.dossier_dir):
+                    obs_metrics.counter("obs.dossiers_written").inc()
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (dossiers are evidence, never a new failure mode: a full disk must not take the death path down)
+                pass
+
+        threading.Thread(target=_write, name="dossier-writer",
+                         daemon=True).start()
 
     def handle_obs_dump(self, clear: bool = False):
         """Everything collected so far (export_trace / dump_metrics read
@@ -1540,6 +1782,7 @@ class Head:
 
     def monitor_loop(self) -> None:
         last_zygote_check = 0.0
+        last_self_ingest = 0.0
         while not self.shutting_down:
             time.sleep(0.05)
             with self.lock:
@@ -1555,6 +1798,18 @@ class Head:
             # if the fork template dies — restart it (cheap pid probe, 2s
             # cadence; launch_worker's cold fallback covers the gap)
             now = time.monotonic()
+            if now - last_self_ingest > 1.0:
+                last_self_ingest = now
+                # the head's ~1s telemetry tick: ship its OWN registry (the
+                # authoritative per-tenant byte gauges live here) through
+                # the direct-ingest hook so the TSDB behind the scrape
+                # endpoint always carries fresh head-side series
+                try:
+                    from raydp_tpu.obs.tracing import flush_throttled
+
+                    flush_throttled(1.0)
+                except Exception:  # raydp-lint: disable=swallowed-exceptions (a telemetry tick must never take the monitor loop down)
+                    pass
             if now - last_zygote_check > 2.0:
                 last_zygote_check = now
                 self._ensure_zygote()
@@ -1780,6 +2035,7 @@ def run_head(session_dir: str, driver_pid: int, default_resources: Dict[str, flo
         server.server_close()
         tcp_server.shutdown()
         tcp_server.server_close()
+        head.close_scrape_server()
         try:
             sanitize.audit_leaks("head")
         except sanitize.LeakError:

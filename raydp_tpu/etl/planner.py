@@ -117,6 +117,7 @@ class Planner:
         import threading
 
         self.last_query_stats: dict = {}
+        self.last_query_records: list = []  # raw spans behind the stats
         self._tls = threading.local()
         # dynamic allocation: the session installs a hook called with each
         # stage's width BEFORE dispatch (scale-up happens in time for the
@@ -689,6 +690,21 @@ class Planner:
                 L.recover_blocks(self, ids)
         except _ClusterError:
             obs.instant("lineage.recovery_failed", blocks=len(ids))
+            # an UNRECOVERED query is exactly what the flight recorder
+            # exists for: ask the head for a crash dossier naming the lost
+            # blocks while the victims' final rings are still resident.
+            # Best-effort and bounded — evidence, never a new failure mode.
+            try:
+                from raydp_tpu.cluster import api as _capi
+
+                _capi.head_rpc(
+                    "obs_dossier", reason="unrecovered_query",
+                    victim={"lost_blocks": ids[:16],
+                            "error": repr(exc)[:300]},
+                    timeout=10.0,
+                )
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (dossier assembly is best-effort; the original lost-block error is what the caller must see)
+                pass
             return False
         finally:
             self._tls.in_recovery = False
@@ -1105,6 +1121,15 @@ class Planner:
             "rpc": rpc_stats,
             "recovery": recovery,
         }
+        # the raw span records behind the stats: what explain_last_query /
+        # obs.analysis walks for critical-path attribution (kept by
+        # reference — the list is already materialized, this is one assign)
+        self.last_query_records = records
+        # telemetry tick: put this query's spans + the driver registry on
+        # the head so the scrape endpoint / TSDB stay live under an
+        # interactive workload (throttled — a 1000-query burst pays one
+        # RPC per second, not per query)
+        obs.flush_throttled(1.0)
         return results
 
     # ------------------------------------------------------------------

@@ -463,6 +463,42 @@ class EtlSession:
         _obs.metrics.counter("etl.reown_failures")
         _obs.metrics.counter("rpc.retries")
         _obs.metrics.counter("rpc.deadline_exceeded")
+        # telemetry plane v2 (docs/observability.md): hand the head its
+        # obs.* confs — span-ring capacity, dossier dir, and (when asked)
+        # the Prometheus scrape endpoint. ``obs.scrape_port`` off by
+        # default; "auto"/0 binds an ephemeral port reported back here.
+        #   obs.scrape_port      — off | auto | <port>
+        #   obs.head_ring_spans  — head trace-ring capacity (spans)
+        #   obs.dossier_dir      — where crash dossiers land
+        self.scrape_addr: Optional[tuple] = None
+        scrape_conf = str(self.configs.get("obs.scrape_port", "off")).lower()
+        ring_conf = self.configs.get("obs.head_ring_spans")
+        dossier_conf = self.configs.get("obs.dossier_dir")
+        if scrape_conf not in ("off", "", "false") or ring_conf or dossier_conf:
+            try:
+                settings = cluster.head_rpc(
+                    "obs_configure",
+                    head_ring_spans=(
+                        int(ring_conf) if ring_conf is not None else None
+                    ),
+                    dossier_dir=(
+                        str(dossier_conf) if dossier_conf else None
+                    ),
+                    scrape_port=(
+                        (0 if scrape_conf in ("auto", "0") else int(scrape_conf))
+                        if scrape_conf not in ("off", "", "false") else None
+                    ),
+                    timeout=15.0,
+                )
+                addr = settings.get("scrape_addr")
+                self.scrape_addr = tuple(addr) if addr else None
+            except Exception:
+                # an older head without the op (or a mid-boot hiccup): the
+                # session still works, just without the live endpoints
+                _obs.log.warning(
+                    "obs_configure failed; scrape/dossier confs not applied",
+                    exc_info=True,
+                )
         if self._dyn_enabled:
             self._planner.scale_hook = self._on_stage_width
             threading.Thread(
@@ -543,6 +579,20 @@ class EtlSession:
     def export_trace(self, path: str) -> str:
         """Write the cluster's collected trace as Perfetto JSON."""
         return cluster.export_trace(path)
+
+    def query_metrics(self, name: str, window_s: float = 60.0,
+                      labels: Optional[Dict[str, str]] = None,
+                      aggregate: bool = False):
+        """Windowed time-series from the head TSDB (see
+        ``cluster.query_metrics`` / docs/observability.md)."""
+        return cluster.query_metrics(name, window_s, labels, aggregate)
+
+    def explain_last_query(self, top_k: int = 5) -> dict:
+        """Critical-path wall-time attribution of the last query
+        (obs/analysis.py; the report's ``text`` field is human-readable)."""
+        from raydp_tpu.obs.analysis import explain_last_query
+
+        return explain_last_query(session=self, top_k=top_k)
 
     # ------------------------------------------------------------------
     # dynamic allocation (reference doRequestTotalExecutors/doKillExecutors,

@@ -35,6 +35,8 @@ from raydp_tpu.obs.tracing import (
     flush,
     flush_throttled,
     instant,
+    mint_context,
+    record_span,
     set_process_role,
     span,
     use_context,
@@ -47,6 +49,7 @@ __all__ = [
     "current_context",
     "current_sinks",
     "enabled",
+    "explain_last_query",
     "export_trace",
     "flush",
     "flush_throttled",
@@ -54,6 +57,9 @@ __all__ = [
     "instant",
     "log",
     "metrics",
+    "mint_context",
+    "query_local_series",
+    "record_span",
     "set_process_role",
     "span",
     "use_context",
@@ -75,3 +81,21 @@ def dump_metrics() -> dict:
     from raydp_tpu.obs.export import dump_metrics as _dump
 
     return _dump()
+
+
+def explain_last_query(session=None, top_k: int = 5) -> dict:
+    """Critical-path wall-time attribution of the active session's last
+    query (obs/analysis.py). Lazy import: the analyzer touches the session
+    layer, which obs call sites inside it must never pull in at import."""
+    from raydp_tpu.obs.analysis import explain_last_query as _explain
+
+    return _explain(session=session, top_k=top_k)
+
+
+def query_local_series(name: str, window_s: float = 60.0, labels=None):
+    """This process's windowed time-series mirror (obs/timeseries.py) —
+    what in-process controllers read; ``cluster.query_metrics`` is the
+    cluster-wide (head TSDB) flavor."""
+    from raydp_tpu.obs.timeseries import query_local
+
+    return query_local(name, window_s, labels)

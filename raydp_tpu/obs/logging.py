@@ -33,6 +33,16 @@ class StructuredLogger:
 
         role = self._role or process_role()
         actor = os.environ.get("RAYDP_TPU_ACTOR_ID", "")
+        try:
+            # flight recorder (obs/recorder.py): every structured line also
+            # lands in the process's bounded ring and ships with the next
+            # telemetry flush, so a crash dossier carries the victim's last
+            # log lines, not just its spans
+            from raydp_tpu.obs.recorder import note_log
+
+            note_log(level, role, message, fields)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (logging must never fail because the recorder could not import mid-teardown)
+            pass
         ts = time.strftime("%Y-%m-%d %H:%M:%S", time.gmtime())
         parts = [ts, level, f"[{role}" + (f" {actor}" if actor else "") + "]", message]
         if fields:

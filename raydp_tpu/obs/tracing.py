@@ -237,6 +237,47 @@ def instant(name: str, **attrs) -> None:
         _buffer_append(record)
 
 
+def record_span(
+    name: str,
+    ts_us: int,
+    dur_us: int,
+    trace: str,
+    span_id: Optional[str] = None,
+    parent: Optional[str] = None,
+    **attrs,
+) -> dict:
+    """Emit a span RECORD for an interval measured elsewhere — the serving
+    plane's request-path spans are assembled from per-request timestamps
+    AFTER the request resolves (a live ``span()`` context manager cannot
+    straddle the admission queue, the batch, and the dispatcher thread).
+    Same consumers as ``Span.__exit__``: collectors and, when shipping is
+    on, the ring buffer. Returns the record (its ``id`` links children)."""
+    record = {
+        "name": name,
+        "ts": int(ts_us),
+        "dur": max(0, int(dur_us)),
+        "pid": os.getpid(),
+        "tid": threading.get_ident() % 1_000_000,
+        "proc": process_role(),
+        "trace": trace,
+        "id": span_id or uuid.uuid4().hex[:16],
+        "parent": parent,
+        "args": attrs,
+    }
+    for sink in _collectors():
+        sink.append(record)
+    if _enabled:
+        _buffer_append(record)
+    return record
+
+
+def mint_context() -> Tuple[str, str]:
+    """A fresh (trace_id, span_id) pair for a root minted out-of-band (the
+    serve request path samples requests at admission and emits their spans
+    at resolution via ``record_span``)."""
+    return uuid.uuid4().hex[:16], uuid.uuid4().hex[:16]
+
+
 def current_sinks() -> List[list]:
     """This thread's active collector sinks — capture them before handing
     work to a helper thread, and re-install there with ``use_sinks`` so the
@@ -341,6 +382,20 @@ def flush() -> bool:
     snapshot = metrics.snapshot()
     if not spans and not snapshot:
         return True
+    # the process-local time-series mirror rides the same tick: in-process
+    # controllers (serve autoscaler, tenancy policies) get the identical
+    # windowed signal a head scrape would show
+    try:
+        from raydp_tpu.obs import timeseries as _ts
+
+        _ts.ingest_local(snapshot)
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (the local mirror must never block shipping to the head)
+        pass
+    # flight-recorder log ring: shipped alongside spans/metrics so the head
+    # holds every process's recent log lines for crash dossiers
+    from raydp_tpu.obs import recorder as _recorder
+
+    logs = _recorder.drain_logs()
     proc = {"pid": os.getpid(), "role": process_role(), "dropped": _dropped}
     try:
         # the head's direct-ingest hook comes FIRST: the head process has
@@ -349,7 +404,8 @@ def flush() -> bool:
         # flush and park head spans in the (smaller) process ring forever
         ingest = _local_ingest
         if ingest is not None:
-            ingest(proc=proc, spans=spans, metrics_snapshot=snapshot)
+            ingest(proc=proc, spans=spans, metrics_snapshot=snapshot,
+                   logs=logs)
             return True
         from raydp_tpu.cluster import api as cluster_api
 
@@ -359,7 +415,7 @@ def flush() -> bool:
             raise RuntimeError("no cluster")
         cluster_api.head_rpc(
             "obs_ingest", proc=proc, spans=spans,
-            metrics_snapshot=snapshot, timeout=10.0,
+            metrics_snapshot=snapshot, logs=logs, timeout=10.0,
         )
         return True
     except Exception:
@@ -373,6 +429,7 @@ def flush() -> bool:
             _dropped += len(spans) - len(kept)
             for record in reversed(kept):
                 _buffer.appendleft(record)
+        _recorder.requeue_logs(logs)
         return False
 
 

@@ -46,17 +46,39 @@ class ServeController:
         self._thread.start()
 
     def _default_signals(self) -> dict:
-        """The obs-gauge inputs (docs/observability.md ``serve.*`` rows)."""
+        """The decision inputs, read from the WINDOWED time-series mirror
+        (obs/timeseries.py) with the live gauges as the freshness floor:
+        the p99 signal is the max over the recent window — one sub-tick dip
+        between flushes must not reset a sustained-breach streak — and a
+        scrape of the head shows a controller-shaped consumer the exact
+        same series (docs/observability.md "Time series")."""
+        from raydp_tpu.obs import timeseries as _ts
+
+        p99 = obs.metrics.gauge("serve.p99_ms").value
+        # window ~2 ticks: wide enough to bridge a sub-tick dip between
+        # flushes, NARROWER than the sustained period — a single spiky
+        # flush must not read as hot for >= sustained_ticks consecutive
+        # ticks (that would convert one burst sample into a scale-out,
+        # the exact failure the sustained-signal shape exists to prevent)
+        window_s = max(2.0 * self._conf.tick_s, 0.5)
+        windowed = _ts.windowed_local("serve.p99_ms", window_s=window_s)
+        if windowed["series"]:
+            p99 = max(p99, windowed["max"] or 0.0)
         return {
             "queue_rows": obs.metrics.gauge("serve.queue_depth").value,
             "inflight": self._deployment.batcher.inflight_total(),
-            "p99_ms": obs.metrics.gauge("serve.p99_ms").value,
+            "p99_ms": p99,
         }
 
     def _run(self) -> None:
         while not self._stop.wait(self._conf.tick_s):
             try:
                 self.tick()
+                # the serving driver's ~1s telemetry tick: ship the batcher
+                # gauges/histograms so the head TSDB (scrape endpoint,
+                # query_metrics) stays live under request load — and feed
+                # this process's own windowed mirror for the signals above
+                obs.flush_throttled(1.0)
             except Exception:
                 obs.log.error("serve controller tick failed", exc_info=True)
 

@@ -26,6 +26,7 @@ OUTSIDE it in the dispatcher threads.
 
 from __future__ import annotations
 
+import random as _random
 import threading
 import time
 from collections import deque
@@ -49,7 +50,8 @@ _RETRYABLE = (ClusterError, ConnectionError, EOFError, OSError, TimeoutError)
 
 
 class _Request:
-    __slots__ = ("rows", "n", "done", "value", "error", "retries", "t_enqueue")
+    __slots__ = ("rows", "n", "done", "value", "error", "retries",
+                 "t_enqueue", "t_formed", "t_dispatch", "t_reply", "ctx")
 
     def __init__(self, rows, n: int):
         self.rows = rows
@@ -59,6 +61,15 @@ class _Request:
         self.error: Optional[BaseException] = None
         self.retries = 0
         self.t_enqueue = time.monotonic()
+        # request-path tracing (docs/observability.md "Request traces"):
+        # stage stamps are taken for EVERY request (three monotonic reads —
+        # they feed the serve.stage.* histograms behind stats()'s latency
+        # decomposition); ctx is a minted (trace_id, span_id) for SAMPLED
+        # requests only, whose spans are emitted at resolution
+        self.t_formed: Optional[float] = None
+        self.t_dispatch: Optional[float] = None
+        self.t_reply: Optional[float] = None
+        self.ctx: Optional[tuple] = None
 
     def resolve(self, value) -> None:
         self.value = value
@@ -126,6 +137,22 @@ class DynamicBatcher:
         self._g_queue = m.gauge("serve.queue_depth")
         self._g_inflight = m.gauge("serve.inflight")
         self._g_p99 = m.gauge("serve.p99_ms")
+        # per-stage latency decomposition (every request feeds these; the
+        # serve.request trace spans are the sampled mirror of the same
+        # stamps): queue_wait = admission→batch pop, batch_form =
+        # pop→dispatch send (concat/pad/admission ticket), dispatch =
+        # send→reply minus replica compute, compute = replica-reported,
+        # respond = reply→caller resolution
+        self._h_stages = {
+            stage: m.histogram(f"serve.stage.{stage}_s")
+            for stage in (
+                "queue_wait", "batch_form", "dispatch", "compute", "respond",
+            )
+        }
+        # request-trace sampling (obs.request_sample_rate): spans only ship
+        # when tracing is enabled; the rate keeps a 650 req/s closed loop
+        # from drowning the span rings
+        self._sample_rate = float(getattr(conf, "request_sample_rate", 0.0))
 
         self._dispatch_slots = threading.Semaphore(conf.dispatchers)
         self._pool = ThreadPoolExecutor(
@@ -193,6 +220,14 @@ class DynamicBatcher:
                 f"{self._conf.max_batch_size}"
             )
         req = _Request(rows, n)
+        if self._sample_rate > 0.0:
+            from raydp_tpu.obs import tracing as _tracing
+
+            if _tracing.enabled() and (
+                self._sample_rate >= 1.0
+                or _random.random() < self._sample_rate
+            ):
+                req.ctx = _tracing.mint_context()
         with self._cond:
             if self._stop:
                 raise RuntimeError("serving deployment is closed")
@@ -276,6 +311,9 @@ class DynamicBatcher:
                     self._cond.wait(max(wait_s, 0.001))
                 depth = self._queued_rows
             self._g_queue.set(depth)
+            t_formed = time.monotonic()
+            for req in batch:
+                req.t_formed = t_formed
             self._pool.submit(self._dispatch, batch)
 
     def _pick_replica(self):
@@ -417,10 +455,26 @@ class DynamicBatcher:
             time.sleep(0.02)
             self._requeue_front(batch, charge_retry=False, error=None)
             return
+        # fan-in trace node: ONE serve.batch span parents the dispatch and
+        # the replica's compute span (the RPC frame carries its context),
+        # and links every sampled request in the batch via args — emitted
+        # after the reply, when its duration is known
+        from raydp_tpu.obs import tracing as _tracing
+
+        sampled = [req for req in batch if req.ctx is not None]
+        batch_ctx = None
+        if sampled and _tracing.enabled():
+            import uuid as _uuid
+
+            batch_ctx = (sampled[0].ctx[0], _uuid.uuid4().hex[:16])
+        t_dispatch = time.monotonic()
+        for req in batch:
+            req.t_dispatch = t_dispatch
         try:
-            out = handle.infer.options(
-                timeout=conf.request_timeout_s
-            ).remote(padded, n).result()
+            with _tracing.use_context(batch_ctx):
+                out = handle.infer.options(
+                    timeout=conf.request_timeout_s
+                ).remote(padded, n).result()
         except _RETRYABLE as exc:
             self._release_replica(handle.actor_id)
             self._m_errors.inc()
@@ -433,6 +487,14 @@ class DynamicBatcher:
             for req in batch:
                 req.fail(exc)
             return
+        t_reply = time.monotonic()
+        compute_s = 0.0
+        if isinstance(out, tuple) and len(out) == 2:
+            # replicas report their on-device compute seconds alongside the
+            # rows (an older replica returning a bare array still works)
+            out, compute_s = out
+        for req in batch:
+            req.t_reply = t_reply
         self._release_replica(handle.actor_id)
         self._m_batches.inc()
         # doorbell evidence: a completed dispatch returns its pooled socket
@@ -453,6 +515,12 @@ class DynamicBatcher:
             latency_s = now - req.t_enqueue
             self._m_latency.observe(latency_s)
             latencies.append(latency_s * 1000.0)
+        self._observe_stages(batch, now, compute_s)
+        if batch_ctx is not None:
+            self._emit_request_spans(
+                batch, sampled, batch_ctx, now, compute_s,
+                replica=handle.actor_id, batch_rows=n,
+            )
         # the window deque is shared across dispatcher threads: mutate AND
         # snapshot it under the condition (a deque mutated mid-iteration
         # raises, which would silently starve the SLO gauge under exactly
@@ -463,6 +531,74 @@ class DynamicBatcher:
         if window:
             self._g_p99.set(window[min(len(window) - 1,
                                        int(len(window) * 0.99))])
+
+    def _observe_stages(self, batch: List[_Request], t_done: float,
+                        compute_s: float) -> None:
+        """Feed the per-stage latency histograms from one resolved batch's
+        stamps (dispatch = wire+wait minus the replica's reported compute)."""
+        h = self._h_stages
+        for req in batch:
+            if req.t_formed is None or req.t_dispatch is None or req.t_reply is None:
+                continue
+            h["queue_wait"].observe(max(0.0, req.t_formed - req.t_enqueue))
+            h["batch_form"].observe(max(0.0, req.t_dispatch - req.t_formed))
+            h["dispatch"].observe(
+                max(0.0, req.t_reply - req.t_dispatch - compute_s)
+            )
+            h["compute"].observe(max(0.0, compute_s))
+            h["respond"].observe(max(0.0, t_done - req.t_reply))
+
+    def _emit_request_spans(self, batch: List[_Request],
+                            sampled: List[_Request], batch_ctx: tuple,
+                            t_done: float, compute_s: float,
+                            replica: str, batch_rows: int) -> None:
+        """Emit the sampled request-path trace for one batch: per request a
+        ``serve.request`` root with queue_wait / batch_form / dispatch /
+        respond children, plus ONE ``serve.batch`` fan-in span (parented
+        under the first sampled request, linking every sampled request span
+        by id) whose context already rode the replica RPC — the replica's
+        ``serve.replica_infer`` span lands under it."""
+        from raydp_tpu.obs.tracing import record_span
+
+        now_wall_us = time.time_ns() // 1000
+        now_mono = time.monotonic()
+
+        def wall(stamp: Optional[float]) -> int:
+            if stamp is None:
+                return now_wall_us
+            return now_wall_us - int((now_mono - stamp) * 1e6)
+
+        first = sampled[0]
+        record_span(
+            "serve.batch",
+            wall(first.t_dispatch), int((first.t_reply - first.t_dispatch) * 1e6),
+            trace=batch_ctx[0], span_id=batch_ctx[1], parent=first.ctx[1],
+            rows=int(batch_rows), requests=len(batch), replica=replica,
+            compute_s=round(compute_s, 6),
+            request_spans=[req.ctx[1] for req in sampled],
+            request_traces=[req.ctx[0] for req in sampled],
+        )
+        for req in sampled:
+            trace, span_id = req.ctx
+            record_span(
+                "serve.request", wall(req.t_enqueue),
+                int((t_done - req.t_enqueue) * 1e6),
+                trace=trace, span_id=span_id, parent=None,
+                rows=req.n, retries=req.retries, batch_span=batch_ctx[1],
+            )
+            for name, lo, hi in (
+                ("serve.queue_wait", req.t_enqueue, req.t_formed),
+                ("serve.batch_form", req.t_formed, req.t_dispatch),
+                ("serve.dispatch", req.t_dispatch, req.t_reply),
+                ("serve.respond", req.t_reply, t_done),
+            ):
+                if lo is None or hi is None:
+                    continue
+                record_span(
+                    name, wall(lo), int((hi - lo) * 1e6),
+                    trace=trace, parent=span_id,
+                    batch_span=batch_ctx[1],
+                )
 
     def _note_failure(self, handle) -> None:
         with self._cond:
@@ -483,7 +619,7 @@ class DynamicBatcher:
 
     def stats(self) -> dict:
         with self._cond:
-            return {
+            out = {
                 "queued_rows": self._queued_rows,
                 "queued_requests": len(self._queue),
                 "inflight": sum(self._inflight.values()),
@@ -491,6 +627,20 @@ class DynamicBatcher:
                 "draining": len(self._draining),
                 "failed": len(self._failed),
             }
+        # per-stage latency decomposition (docs/observability.md): the same
+        # stamps the sampled request traces are built from, as cumulative
+        # histograms — p50/mean per stage in milliseconds
+        stages = {}
+        for stage, hist in self._h_stages.items():
+            if hist.count:
+                p50 = hist.quantile(0.50)
+                stages[stage] = {
+                    "p50_ms": round((p50 or 0.0) * 1e3, 3),
+                    "mean_ms": round(hist.sum / hist.count * 1e3, 3),
+                    "count": hist.count,
+                }
+        out["stage_latency"] = stages
+        return out
 
     def queued_rows(self) -> int:
         with self._cond:

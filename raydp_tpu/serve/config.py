@@ -63,6 +63,12 @@ class ServeConf:
     # -- replicas -------------------------------------------------------
     replica_light: bool = True  # zygote warm fork (python -S); see docs
     replica_max_concurrency: int = 4
+    # -- request-path tracing (docs/observability.md) -------------------
+    # fraction of requests that mint a trace context and emit the sampled
+    # serve.request / serve.batch / replica span chain (only when tracing
+    # is enabled — RAYDP_TPU_TRACE); the per-stage latency HISTOGRAMS are
+    # always on regardless. Conf key: ``obs.request_sample_rate``.
+    request_sample_rate: float = 0.01
     # -- tenancy (docs/multitenancy.md) ---------------------------------
     # name a tenant and this deployment's batch dispatches ride the same
     # fair-share admission queue as that tenant's ETL stages — serving and
@@ -85,7 +91,7 @@ class ServeConf:
             if session is not None:
                 merged.update(
                     {k: v for k, v in session.configs.items()
-                     if k.startswith("serve.")}
+                     if k.startswith(("serve.", "obs."))}
                 )
         except Exception:  # raydp-lint: disable=swallowed-exceptions (serving works without any ETL session)
             pass
@@ -118,6 +124,9 @@ class ServeConf:
             replica_light=_flag(get("replica_light"), True),
             replica_max_concurrency=max(
                 2, int(get("replica_max_concurrency", 4))
+            ),
+            request_sample_rate=float(
+                merged.get("obs.request_sample_rate", 0.01)
             ),
             tenant=str(get("tenant", "") or ""),
             extra=merged,

@@ -169,18 +169,33 @@ class ModelReplica:
             return self.info()
 
     def infer(self, x, n_valid: int):
-        """Run the batch through the active generation and return the FIRST
-        ``n_valid`` prediction rows as host numpy — padded rows are sliced
-        off server-side, so they cannot leak into any response."""
+        """Run the batch through the active generation and return
+        ``(rows, compute_s)``: the FIRST ``n_valid`` prediction rows as host
+        numpy — padded rows are sliced off server-side, so they cannot leak
+        into any response — plus the measured compute seconds (the batcher's
+        per-stage latency decomposition and the dispatch-vs-compute split in
+        request traces both read it). The ``serve.replica_infer`` span
+        parents under the dispatching batch's trace context, which rode in
+        on the RPC frame — the replica-side hop of a sampled request
+        trace."""
+        import time as _time
+
         from raydp_tpu import obs
 
         state = self._active
-        fn = state.compiled_for(x)
-        out = np.asarray(fn(state.params, x))[: int(n_valid)]
+        with obs.span(
+            "serve.replica_infer", rows=int(n_valid),
+            fingerprint=state.fingerprint,
+        ):
+            fn = state.compiled_for(x)
+            t0 = _time.perf_counter()
+            out = np.asarray(fn(state.params, x))[: int(n_valid)]
+            compute_s = _time.perf_counter() - t0
         obs.metrics.counter("serve.replica.infers").inc()
         obs.metrics.counter("serve.replica.rows").inc(int(n_valid))
+        obs.metrics.histogram("serve.replica.compute_s").observe(compute_s)
         obs.flush_throttled()
-        return out
+        return out, compute_s
 
     def reload(self) -> dict:
         """Pick up the newest checkpoint (rolling reload entry point). Old

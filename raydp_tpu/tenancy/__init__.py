@@ -42,7 +42,48 @@ from raydp_tpu.tenancy.scheduler import (
     Ticket,
 )
 
+
+def fair_share_series(tenant: str, window_s: float = 60.0):
+    """The tenant's fair-share signals as WINDOWED time-series aggregates —
+    queue depth, tasks dispatched, queue-wait p99, and the HEAD-side byte
+    accounting — keyed exactly like a head scrape's ``tenant="<ns>"``
+    labeled series, so policies and dashboards read one signal. Reads the
+    head TSDB (``bytes_stored`` lives only in the head's registry; the
+    head self-ingests it every ~1s) and degrades to this process's local
+    mirror when no cluster is running. Returns
+    ``{metric: windowed-aggregate}``."""
+    import os as _os
+
+    from raydp_tpu.cluster import api as _capi
+    from raydp_tpu.cluster.common import SESSION_ENV as _SESSION_ENV
+    from raydp_tpu.obs import timeseries as _ts
+    from raydp_tpu.obs.tracing import flush as _flush
+
+    labels = {"tenant": tenant}
+    metrics = (
+        "queue_depth", "tasks_dispatched", "quota_rejections",
+        "queue_wait_s.p99", "bytes_stored",
+    )
+    _flush()  # ONE registry ship; the whole group then reads in ONE RPC
+    try:
+        if _capi.is_initialized() or _os.environ.get(_SESSION_ENV):
+            got = _capi.head_rpc(
+                "obs_query_series",
+                name=[f"tenant.{name}" for name in metrics],
+                window_s=window_s, labels=labels, aggregate=True,
+                timeout=10.0,
+            )
+            return {name: got[f"tenant.{name}"] for name in metrics}
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (no cluster (or dead head): the local mirror below still answers)
+        pass
+    return {
+        name: _ts.local_store.windowed(f"tenant.{name}", window_s, labels)
+        for name in metrics
+    }
+
+
 __all__ = [
+    "fair_share_series",
     "TenantQuotaError",
     "AdmissionHandle",
     "FairShareScheduler",

@@ -889,11 +889,22 @@ def run(scenarios) -> dict:
         results.append({"name": "cluster_shutdown", "ok": False,
                         "error": repr(exc)[:500]})
     unrecovered = sum(1 for r in results if not r.get("ok"))
+    # crash dossiers (obs/recorder.py): every SIGKILL the scenarios injected
+    # made the head write one — attach the inventory so a failed run's
+    # artifact carries the victims' final spans/logs, not just verdicts
+    dossier_dir = os.environ.get("RAYDP_TPU_DOSSIER_DIR", "")
+    dossiers: List[str] = []
+    if dossier_dir:
+        from raydp_tpu.obs.recorder import list_dossiers
+
+        dossiers = list_dossiers(dossier_dir)
     return {
         "sanitize_modes": os.environ.get("RAYDP_TPU_SANITIZE", ""),
         "scenarios": results,
         "unrecovered_queries": unrecovered,
         "sanitizer_findings": sanitizer_findings,
+        "dossier_dir": dossier_dir or None,
+        "dossiers": dossiers,
         "ok": unrecovered == 0 and sanitizer_findings == 0,
     }
 
@@ -914,6 +925,11 @@ def main(argv=None) -> int:
     os.environ.setdefault("JAX_PLATFORMS", "cpu")
     os.environ.setdefault(
         "RAYDP_TPU_SANITIZE", "donation,lockdep,leaks-strict"
+    )
+    # crash dossiers land in one well-known dir (the heads the scenarios
+    # boot inherit this env) so CI can upload them as artifacts on failure
+    os.environ.setdefault(
+        "RAYDP_TPU_DOSSIER_DIR", os.path.abspath("chaos_dossiers")
     )
     report = run(QUICK if args.quick else FULL)
     report["seed"] = args.seed

@@ -1,10 +1,15 @@
-"""CI smoke: one traced ETL→fit run, exported and validated as Perfetto JSON.
+"""CI smoke: one traced ETL→fit→serve run, exported and validated as
+Perfetto JSON.
 
 Run: ``python tools/trace_smoke.py [out.json]``. Asserts the trace contains
 complete spans from at least three distinct processes (driver, head, and at
 least one executor actor) linked under a shared trace id — the end-to-end
-guarantee the tracing plane makes. CI uploads the resulting file as a build
-artifact so any run's timeline can be opened in https://ui.perfetto.dev.
+guarantee the tracing plane makes — AND that one sampled SERVE request's
+trace spans at least three processes under one trace id (driver request/
+batch spans, the head's actor-lookup span, and the replica's compute span:
+the request-path tracing contract of docs/observability.md). CI uploads the
+resulting file as a build artifact so any run's timeline can be opened in
+https://ui.perfetto.dev.
 """
 # raydp-lint: disable-file=print-diagnostics (standalone CI tool: its stdout IS the report, there is no obs role to tag)
 
@@ -48,11 +53,33 @@ def main() -> None:
         "z", F.col("x") * 2 + F.col("y")
     )
     ds = dataframe_to_dataset(df)
+    import tempfile
+
     est = JaxEstimator(
         model=MLP(), loss="mse", feature_columns=["x", "y"],
         label_column="z", batch_size=128, num_epochs=2, donate_state=False,
+        checkpoint_dir=tempfile.mkdtemp(prefix="trace-smoke-ckpt-"),
     )
     est.fit(ds)
+
+    # serve leg: a one-replica deployment with every request sampled; the
+    # replica flushes its spans on a throttle, so a second wave of requests
+    # after the throttle window ships the first wave's compute spans
+    import time
+
+    from raydp_tpu import serve
+
+    x = pdf[["x", "y"]].to_numpy(np.float32)
+    dep = serve.deploy(
+        est, replicas=1, example=x[0],
+        conf={"serve.max_batch_size": 8, "obs.request_sample_rate": 1.0},
+    )
+    for i in range(4):
+        dep.predict(x[i : i + 1])
+    time.sleep(0.7)
+    dep.predict(x[0:1])
+    time.sleep(0.2)
+    dep.close()
 
     path = sys.argv[1] if len(sys.argv) > 1 else "trace_smoke.json"
     raydp_tpu.export_trace(path)
@@ -78,10 +105,40 @@ def main() -> None:
     assert stage_traces & task_traces, (
         f"task spans not linked to stage traces: {stage_traces} vs {task_traces}"
     )
+    # serve request-path linkage: at least one sampled request trace whose
+    # spans come from >=3 processes (driver, head, replica) under ONE
+    # trace id — the fan-in request → batch → replica-compute chain
+    track_proc = {
+        e["pid"]: e["args"]["name"].split(" ", 1)[0]
+        for e in events if e["ph"] == "M"
+    }
+    request_traces = {
+        e["args"]["trace_id"] for e in complete if e["name"] == "serve.request"
+    }
+    assert request_traces, "no sampled serve.request spans in trace"
+    best_procs: set = set()
+    for trace_id in request_traces:
+        procs_in_trace = {
+            track_proc.get(e["pid"], str(e["pid"]))
+            for e in complete if e["args"].get("trace_id") == trace_id
+        }
+        if len(procs_in_trace) > len(best_procs):
+            best_procs = procs_in_trace
+    assert len(best_procs) >= 3, (
+        f"serve request trace spans only {best_procs} — expected >=3 "
+        "processes (driver, head, replica) under one trace id"
+    )
+    batch_spans = [e for e in complete if e["name"] == "serve.batch"]
+    infer_spans = [e for e in complete if e["name"] == "serve.replica_infer"]
+    assert batch_spans and infer_spans, (
+        f"missing serve fan-in spans: {len(batch_spans)} batch, "
+        f"{len(infer_spans)} replica_infer"
+    )
     metrics = raydp_tpu.dump_metrics()
     assert metrics, "dump_metrics returned nothing"
     print(
         f"trace ok: {len(events)} events from {len(procs)} processes, "
+        f"serve request trace across {len(best_procs)} processes, "
         f"{len(metrics)} metric registries -> {path}"
     )
 
