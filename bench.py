@@ -727,6 +727,126 @@ def obs_overhead_probe() -> dict:
         session.stop()
 
 
+def fit_profile_probe() -> dict:
+    """Step-profiler overhead + live-MFU parity (ISSUE 15; perf_smoke
+    gates both).
+
+    Overhead: identical small staged fits (per-step loop forced via
+    scan_epochs=False — the path where the per-step instrumentation
+    actually sits) with the step profiler ON vs OFF, interleaved rounds
+    with rotating lead per the r06 lesson, per-step ms derived from the
+    SAME measurement both arms (history epoch_seconds / steps). Reports
+    median-of-rounds step p50s.
+
+    Parity: the ON arm's ``fit_stats_`` carries the live FLOPs-per-step
+    (XLA cost analysis — the ``estimator.mfu`` gauge's numerator); the
+    bench side computes the analytic number for the same MLP through the
+    SAME library (``costmodel.mlp_train_flops_per_step``). The ratio must
+    land in [0.5, 2.0]: XLA counts the optimizer/elementwise work the
+    matmul-only analytic convention deliberately ignores, so exact
+    equality is not the contract — same-step-described is."""
+    import statistics
+
+    from raydp_tpu.estimator import JaxEstimator
+    from raydp_tpu.obs import costmodel, profiler
+
+    rows = int(os.environ.get("BENCH_FIT_PROBE_ROWS", 4096))
+    rounds = int(os.environ.get("BENCH_FIT_PROBE_ROUNDS", 3))
+    batch = 64
+    dims = (8, 64, 64, 1)
+
+    def _mlp():
+        import flax.linen as nn
+
+        class _ProbeMLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.relu(nn.Dense(dims[1])(x))
+                x = nn.relu(nn.Dense(dims[2])(x))
+                return nn.Dense(dims[3])(x)
+
+        return _ProbeMLP()
+
+    class _HostDs:
+        """Minimal Dataset shim for _stage_host (bench-local: the probe
+        measures the train loop, not the ETL exchange)."""
+
+        def __init__(self, feats, labels):
+            self._f, self._l = feats, labels
+            self.uuid = "fit-profile-probe"
+            self.blocks = []
+
+        def to_numpy(self, feature_columns, label_column, feature_dtype,
+                     label_dtype):
+            return (self._f.astype(feature_dtype),
+                    self._l.astype(label_dtype))
+
+    rng = np.random.default_rng(23)
+    feats = rng.random((rows, dims[0])).astype(np.float32)
+    labels = feats @ rng.random(dims[0]).astype(np.float32)
+    ds = _HostDs(feats, labels)
+
+    def make_est():
+        return JaxEstimator(
+            model=_mlp, optimizer="adam", loss="mse",
+            feature_columns=[f"f{i}" for i in range(dims[0])],
+            label_column="y", batch_size=batch, num_epochs=2,
+            scan_epochs=False, shuffle=True, seed=3,
+        )
+
+    was_on = profiler.step_profiler_enabled()
+    try:
+        est_on, est_off = make_est(), make_est()
+
+        def one_fit(est, arm_on):
+            profiler.set_step_profiler(arm_on)
+            history = est.fit(ds)
+            # the SAME measurement both arms: epoch wall / steps (the off
+            # arm has no step histograms to read, by construction)
+            steps = max(1, (rows // batch) * len(history))
+            total_s = sum(rec["epoch_seconds"] for rec in history)
+            return total_s / steps * 1000.0
+
+        one_fit(est_on, True)  # warm both arms: compile + staging cache
+        one_fit(est_off, False)
+        p50_on, p50_off = [], []
+        for i in range(max(1, rounds)):
+            order = ((True, False), (False, True))[i % 2]  # rotating lead
+            for arm_on in order:
+                sample = one_fit(est_on if arm_on else est_off, arm_on)
+                (p50_on if arm_on else p50_off).append(sample)
+        profiler.set_step_profiler(was_on)
+
+        stats = est_on.fit_stats_
+        flops_live = stats.get("flops_per_step")
+        flops_analytic = costmodel.mlp_train_flops_per_step(batch, dims)
+        ratio = flops_live / flops_analytic if flops_live else None
+        parity_ok = ratio is not None and 0.5 <= ratio <= 2.0
+        return {
+            "rows": rows,
+            "rounds": rounds,
+            "step_p50_on_ms": round(statistics.median(p50_on), 4),
+            "step_p50_off_ms": round(statistics.median(p50_off), 4),
+            "step_p50_on_samples": [round(v, 4) for v in p50_on],
+            "step_p50_off_samples": [round(v, 4) for v in p50_off],
+            "step_phase_seconds": stats.get("step_phase_seconds"),
+            "flops_per_step_live": flops_live,
+            "flops_per_step_analytic": flops_analytic,
+            "flops_ratio": round(ratio, 4) if ratio else None,
+            "mfu_live": stats.get("mfu"),
+            "model_flops_per_sec": stats.get("model_flops_per_sec"),
+            "peak_source": stats.get("peak_source"),
+            "mfu_parity_ok": bool(parity_ok),
+            "ok": bool(parity_ok),
+        }
+    except Exception as exc:  # pragma: no cover - must not kill the bench
+        # restore the PRE-probe state (an explicit profiler-off run must
+        # not be silently re-enabled by a failing probe)
+        profiler.set_step_profiler(was_on)
+        return {"ok": False, "mfu_parity_ok": False,
+                "error": repr(exc)[:300]}
+
+
 def _etl_breakdown(stats):
     """Compact, JSON-ready view of the planner's last_query_stats: per-stage
     task counts, dispatch mode, and the server-side read/compute/emit phase
@@ -1320,37 +1440,27 @@ def validate_flash_compiled():
     }
 
 
-# bf16 peak FLOP/s per jax device, matched by substring of device_kind.
-# v2/v3 expose one device per CORE (half a chip); v4+ one per chip.
-_TPU_PEAK_FLOPS = [
-    ("v6", 918e12),  # Trillium / v6e
-    ("v5p", 459e12),
-    ("v5", 197e12),  # v5e / "v5 lite"
-    ("v4", 275e12),
-    ("v3", 61.5e12),
-    ("v2", 22.5e12),
-]
+# FLOPs accounting + device peaks moved to the library the cluster carries
+# (raydp_tpu/obs/costmodel.py, PR 15): bench and the estimator's live
+# estimator.mfu gauge import the SAME functions — one accounting, bit-
+# identical numbers in both.
+from raydp_tpu.obs.costmodel import (  # noqa: E402 - after env setup above
+    lm_nonattn_flops_per_step,
+    lm_train_flops_per_step,
+    mlp_train_flops_per_step,
+)
 
 
 def _device_peak_flops():
-    import jax
+    """(device_kind, bf16 peak FLOP/s or None) — thin shim over
+    costmodel.device_peak_flops keeping bench's historical TPU-only MFU
+    semantics (the nominal-cpu peak is for live dev-box gauges, not for
+    BENCH_r* MFU numbers)."""
+    from raydp_tpu.obs.costmodel import device_peak_flops
 
-    kind = jax.devices()[0].device_kind
-    low = kind.lower()
-    for sub, peak in _TPU_PEAK_FLOPS:
-        if sub in low:
-            return kind, peak
-    return kind, None
-
-
-def lm_train_flops_per_step(batch, seq, d_model, num_layers, vocab):
-    """Analytic matmul FLOPs of one TransformerLM training step (fwd+bwd,
-    no remat): per token per layer 24*d^2 (qkv 6d^2, proj 2d^2, mlp 16d^2)
-    plus causal attention 2*d*(T+1) (QK^T + AV at average context (T+1)/2),
-    plus the d*V lm_head; backward costs 2x forward."""
-    per_token = num_layers * (24 * d_model**2 + 2 * d_model * (seq + 1))
-    per_token += 2 * d_model * vocab
-    return 3 * batch * seq * per_token
+    info = device_peak_flops()
+    peak = info["peak"] if info["peak_source"] in ("tpu-table", "env") else None
+    return info["kind"], peak
 
 
 def bench_transformer_lm():
@@ -1460,8 +1570,8 @@ def bench_transformer_lm():
             try:
                 noattn_tps = make_runner("skip")()
                 step_s = batch * T / flash_med
-                noattn_flops = 3 * batch * T * (
-                    num_layers * 24 * d_model**2 + 2 * d_model * vocab
+                noattn_flops = lm_nonattn_flops_per_step(
+                    batch, T, d_model, num_layers, vocab
                 )
                 attn_flops = flops_step - noattn_flops
                 noattn_s = batch * T / noattn_tps
@@ -1617,9 +1727,18 @@ def main():
     # the scrape can prove serve_* series liveness
     obs_probe = obs_overhead_probe()
 
+    # compute-observatory probe (raydp_tpu.obs.profiler/costmodel): step-
+    # profiler overhead on the fit step p50 + live-MFU vs analytic parity
+    fit_probe = fit_profile_probe()
+
     # export the whole run's trace (driver + head + executors under the
-    # propagated trace ids) and the merged metrics registries
-    trace_path = os.environ.get("BENCH_TRACE_PATH", "bench_trace.json")
+    # propagated trace ids) and the merged metrics registries — into the
+    # gitignored artifacts/ dir, never the repo root
+    from raydp_tpu.obs.profiler import artifacts_dir
+
+    trace_path = os.environ.get("BENCH_TRACE_PATH") or os.path.join(
+        artifacts_dir(), "bench_trace.json"
+    )
     obs_headline: dict = {}
     try:
         from raydp_tpu.cluster import api as _cluster_api
@@ -1650,6 +1769,7 @@ def main():
             "serving_probe": serving,
             "tenant_isolation_probe": tenant_probe,
             "obs_overhead_probe": obs_probe,
+            "fit_profile_probe": fit_probe,
             "dlrm": dlrm,
             "lm": bench_transformer_lm(),
             "parallel_steps": bench_parallel_steps(),

@@ -1693,9 +1693,25 @@ class Head:
                 }
                 for name, record in self.tenants.items()
             }
+            # memory watermark plane (obs/profiler.py): every process's
+            # newest mem.* gauges (live value + high watermark) from its
+            # shipped registry snapshot — the dossier's memory section
+            memory = {}
+            for proc_key, snapshot in self.obs_metrics.items():
+                mem = {
+                    name: {
+                        "value": snap.get("value"),
+                        "max": snap.get("max"),
+                    }
+                    for name, snap in snapshot.items()
+                    if name.startswith("mem.") and isinstance(snap, dict)
+                }
+                if mem:
+                    memory[proc_key] = mem
             return {
                 "actors": actors,
                 "tenants": tenants,
+                "memory": memory,
                 "objects": len(self.objects),
                 "block_services": {
                     f"{ns or '-'}::{tenant or '-'}": actor_id

@@ -531,14 +531,21 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     # with trace shipping off: epoch/compile wall times in
                     # history and compile_seconds_ are read from the same
                     # span records the trace timeline shows — the obs layer
-                    # is the single timing source, not a parallel one
-                    with obs.collect(), obs.span(
-                        "estimator.fit",
-                        epochs=self.num_epochs,
-                        streaming=str(self.streaming),
-                        attempt=attempts,
-                    ):
-                        return self._fit_once(train_ds, evaluate_ds)
+                    # is the single timing source, not a parallel one. The
+                    # records are kept as ``last_fit_records_`` so
+                    # ``explain_last_fit()`` can attribute the fit's wall
+                    # time the way queries get ``explain_last_query()``.
+                    with obs.collect() as fit_records:
+                        try:
+                            with obs.span(
+                                "estimator.fit",
+                                epochs=self.num_epochs,
+                                streaming=str(self.streaming),
+                                attempt=attempts,
+                            ):
+                                return self._fit_once(train_ds, evaluate_ds)
+                        finally:
+                            self.last_fit_records_ = fit_records
                 except Exception:
                     attempts += 1
                     if attempts > max_retries:
@@ -622,6 +629,23 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             sample_np = _fmap(lambda a: a[:batch_size], train_source.features)
 
         from raydp_tpu import obs
+        from raydp_tpu.obs import costmodel as _costmodel
+        from raydp_tpu.obs import profiler as _profiler
+
+        # compute observatory (obs/profiler.py): the always-on step-phase
+        # recorder (estimator.step.* histograms; RAYDP_TPU_STEP_PROFILER=0
+        # swaps in a shared no-op), an armed on-demand capture window
+        # (session.profile_fit), and the cost model's peak for the live
+        # MFU gauge — all resolved once per fit
+        recorder = self._step_recorder = _profiler.step_recorder()
+        fit_capture = self._fit_capture = _profiler.armed_capture()
+        self._flops_per_step = None
+        self._fit_step_wall = 0.0
+        try:
+            self._peak_info = _costmodel.device_peak_flops()
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (an exotic backend without device_kind must not fail the fit)
+            self._peak_info = {"kind": None, "peak": None,
+                               "peak_source": "unknown"}
 
         enable_persistent_compilation_cache()
         rng = jax.random.PRNGKey(self.seed)
@@ -821,6 +845,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 and start_epoch == 0
                 and start_step == 0
                 and self.num_epochs > 0
+                # an armed capture window needs per-epoch dispatches: the
+                # whole-fit single dispatch has no step boundary for the
+                # budget to stop at, and its trace would show one opaque
+                # launch instead of steady-state steps
+                and fit_capture is None
             ):
                 seeds = [
                     None if not self.shuffle else self.seed + e
@@ -850,6 +879,8 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
             ):
                 epoch_seed = None if not self.shuffle else self.seed + epoch
                 epoch_start_step = start_step if epoch == start_epoch else 0
+                phase_before = recorder.totals()
+                steps_before = getattr(recorder, "steps", 0)
                 # the epoch span IS the epoch timer: history's epoch_seconds
                 # is read from the same record the trace timeline shows
                 with obs.span(
@@ -899,7 +930,27 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                         loss_sum = jnp.zeros((), jnp.float32)
                         steps = epoch_start_step
                         pending_save = None
-                        for x, y in train_iter:
+                        # explicit next() so the step profiler can split
+                        # each iteration into its phases: ingest (host
+                        # slice + queue wait), h2d (device_put dispatch,
+                        # read from the iterator's own split), compute
+                        # (the train_step call), sync (the bounded fence)
+                        profiled = recorder.enabled
+                        t_loop0 = time.perf_counter()
+                        while True:
+                            h2d0 = train_iter.h2d_s
+                            t_iter = time.perf_counter()
+                            try:
+                                x, y = next(train_iter)
+                            except StopIteration:  # raydp-lint: disable=swallowed-exceptions (explicit next(): epoch end is the loop's normal exit)
+                                break
+                            if profiled:
+                                h2d_d = train_iter.h2d_s - h2d0
+                                recorder.note("h2d", h2d_d)
+                                recorder.note(
+                                    "ingest",
+                                    (time.perf_counter() - t_iter) - h2d_d,
+                                )
                             if pending_save is not None:
                                 # DEFERRED one step: a save that would
                                 # coincide with the epoch's final step is
@@ -908,11 +959,14 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                                 # always has tail steps to replay
                                 save_mid_epoch(params, opt_state, epoch, pending_save)
                                 pending_save = None
+                            t_c = time.perf_counter()
                             if not first_step_done:
                                 # the first call compiles (cold TPU compiles
                                 # take tens of seconds); record it so callers
                                 # can report steady-state throughput
                                 # separately
+                                if fit_capture is not None:
+                                    fit_capture.begin_steps()
                                 with obs.span(
                                     "estimator.compile", what="first_step"
                                 ) as cspan:
@@ -922,10 +976,32 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                                     jax.block_until_ready(loss_sum)
                                 self.compile_seconds_ += cspan.duration
                                 first_step_done = True
+                                # XLA's own flops count for the live MFU
+                                # gauge: one extra lower()+compile(), served
+                                # from the (persistent) compilation cache
+                                # the first dispatch just filled
+                                self._flops_per_step = (
+                                    _costmodel.step_flops_from_jitted(
+                                        train_step, params, opt_state,
+                                        loss_sum, x, y,
+                                    )
+                                )
+                                # the compile step is NOT a steady-state
+                                # step: keep it (and the flops lookup) out
+                                # of both the compute histogram and the
+                                # step-wall clock the phases are gated
+                                # against — compile_seconds_ carries it
+                                t_loop0 += time.perf_counter() - t_c
                             else:
                                 params, opt_state, loss_sum = train_step(
                                     params, opt_state, loss_sum, x, y
                                 )
+                                if profiled:
+                                    recorder.note(
+                                        "compute", time.perf_counter() - t_c
+                                    )
+                            if fit_capture is not None:
+                                fit_capture.note_step()
                             steps += 1
                             if save_steps and steps % save_steps == 0:
                                 pending_save = steps
@@ -934,10 +1010,37 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                                 and steps % self.sync_every_steps == 0
                             ):
                                 # bounded pipeline bubble; see __init__
+                                t_s = time.perf_counter()
                                 jax.block_until_ready(loss_sum)
+                                if profiled:
+                                    recorder.note(
+                                        "sync", time.perf_counter() - t_s
+                                    )
+                        self._fit_step_wall += time.perf_counter() - t_loop0
                         steps -= epoch_start_step
                     epoch_span.set(steps=steps)
+                    phase_delta = {
+                        k: v - phase_before.get(k, 0.0)
+                        for k, v in recorder.totals().items()
+                    }
+                    if phase_delta:
+                        # the analyzer's phase-split args: explain_last_fit
+                        # attributes this epoch's interval into ingest/h2d/
+                        # compute/sync exactly like query stage spans split
+                        # by read_s/compute_s/emit_s
+                        epoch_span.set(
+                            ingest_s=round(phase_delta.get("ingest", 0.0), 6),
+                            h2d_s=round(phase_delta.get("h2d", 0.0), 6),
+                            compute_s=round(phase_delta.get("compute", 0.0), 6),
+                            sync_s=round(phase_delta.get("sync", 0.0), 6),
+                        )
                 obs.metrics.counter("estimator.steps").inc(steps)
+                # the RECORDER's step delta, not the loop's: the compile
+                # step is excluded from both numerator and denominator —
+                # the live gauge and fit_stats_ must describe one ratio
+                self._update_live_mfu(
+                    phase_delta, getattr(recorder, "steps", 0) - steps_before
+                )
                 if steps == 0 and epoch_start_step > 0:
                     # resumed exactly at this epoch's end (a stale final-step
                     # checkpoint from an older layout): nothing trained —
@@ -985,6 +1088,13 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 ) / max(self.num_epochs, 1)
                 for rec in self._history:
                     rec["epoch_seconds"] = per_epoch_s
+                # the whole fit was ONE dispatch: its fenced wall time is
+                # the only honest compute figure (per-step phases don't
+                # exist inside a single XLA program)
+                recorder.note(
+                    "compute", per_epoch_s * self.num_epochs,
+                    steps=self.num_epochs * steps_per_epoch,
+                )
             else:
                 stacked = np.asarray(
                     jnp.stack([rec["train_loss"][0] for rec in self._history])
@@ -1000,6 +1110,41 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         self._params = params
         obs.metrics.counter("estimator.fits").inc()
         obs.metrics.gauge("estimator.compile_s").set(self.compile_seconds_)
+        # fit_stats_: the compute observatory's fit-level summary — phase
+        # totals, FLOPs accounting, and the MFU the live gauge reported
+        phase_totals = recorder.totals()
+        device_s = phase_totals.get("compute", 0.0) + phase_totals.get(
+            "sync", 0.0
+        )
+        flops_step = getattr(self, "_flops_per_step", None)
+        steps_total = getattr(recorder, "steps", 0)
+        mfps = (
+            flops_step * steps_total / device_s
+            if flops_step and steps_total and device_s > 0
+            else None
+        )
+        mfu_val = _costmodel.mfu(mfps, self._peak_info.get("peak"))
+        self.fit_stats_ = {
+            "steps": steps_total,
+            "step_phase_seconds": {
+                k: round(v, 6) for k, v in phase_totals.items()
+            },
+            "step_wall_s": (
+                round(self._fit_step_wall, 6) if self._fit_step_wall else None
+            ),
+            "flops_per_step": flops_step,
+            "model_flops_per_sec": mfps,
+            "mfu": mfu_val,
+            "peak_flops": self._peak_info.get("peak"),
+            "device_kind": self._peak_info.get("kind"),
+            "peak_source": self._peak_info.get("peak_source"),
+            "profiler": "on" if recorder.enabled else "off",
+        }
+        if mfps:
+            obs.metrics.gauge("estimator.model_flops_per_sec").set(mfps)
+        if mfu_val is not None:
+            obs.metrics.gauge("estimator.mfu").set(mfu_val)
+        obs.flush_throttled(1.0)
         return self._history
 
     # per-fit streaming pipeline stats (VERDICT r4 weak #4: the streaming
@@ -1007,6 +1152,79 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
     # spent blocked on a full queue (consumer-bound), time the consumer
     # spent blocked on an empty queue (transfer/producer-bound).
     stream_stats_: Dict[str, Any]
+
+    # per-fit compute-observatory summary (obs/profiler.py + obs/costmodel):
+    # step-phase totals, FLOPs accounting, live MFU — docs/estimators.md
+    fit_stats_: Dict[str, Any]
+
+    def explain_last_fit(self, top_k: int = 5) -> dict:
+        """Critical-path wall-time attribution of the last ``fit()`` (the
+        PR 14 analyzer over the fit's span tree: epoch leaves phase-split
+        into ingest/h2d/compute/sync by the step profiler's args). The
+        report's ``text`` field is human-readable."""
+        records = getattr(self, "last_fit_records_", None)
+        if not records:
+            raise RuntimeError("no fit has run on this estimator yet")
+        from raydp_tpu.obs.profiler import explain_fit
+
+        return explain_fit(records, top_k=top_k)
+
+    def _update_live_mfu(self, phase_delta: Dict[str, float],
+                         steps: int) -> None:
+        """Refresh the ``estimator.mfu`` / ``estimator.model_flops_per_sec``
+        gauges from one epoch's measured device time (compute + sync phase
+        seconds) — called at every epoch boundary so a scrape MID-fit shows
+        the live number. Async backends undercount the denominator between
+        fences; ``sync_every_steps`` bounds the error (docs/observability.md
+        "Compute observatory")."""
+        flops_step = getattr(self, "_flops_per_step", None)
+        if not flops_step or not steps:
+            return
+        device_s = phase_delta.get("compute", 0.0) + phase_delta.get(
+            "sync", 0.0
+        )
+        if device_s <= 0.0:
+            return
+        from raydp_tpu import obs
+        from raydp_tpu.obs import costmodel
+
+        mfps = flops_step * steps / device_s
+        obs.metrics.gauge("estimator.model_flops_per_sec").set(mfps)
+        mfu_val = costmodel.mfu(mfps, self._peak_info.get("peak"))
+        if mfu_val is not None:
+            obs.metrics.gauge("estimator.mfu").set(mfu_val)
+        obs.flush_throttled(1.0)
+
+    def _note_step_flops_abstract(self, step_fn: Any, params: Any,
+                                  opt_state: Any, batch_x: Any,
+                                  batch_y: Any) -> None:
+        """Record the fit's FLOPs-per-step for the segment-scanned paths by
+        lowering the SINGLE-step function at the batch's shapes (XLA's
+        cost analysis counts a scan body once regardless of trip count, so
+        the compiled segment executable can't be divided by steps).
+        ``batch_x``/``batch_y`` are one batch's shape donors — arrays or
+        ShapeDtypeStructs. First call wins; failures leave flops unknown."""
+        if getattr(self, "_flops_per_step", None):
+            return
+        try:
+            import jax
+            import jax.numpy as jnp
+
+            from raydp_tpu.obs import costmodel
+
+            def sds(a):
+                return jax.ShapeDtypeStruct(a.shape, a.dtype)
+
+            self._flops_per_step = costmodel.step_flops_abstract(
+                step_fn,
+                jax.tree.map(sds, params),
+                jax.tree.map(sds, opt_state),
+                jax.ShapeDtypeStruct((), jnp.float32),
+                jax.tree.map(sds, batch_x),
+                jax.tree.map(sds, batch_y),
+            )
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (flops stay unknown; the fit is unaffected)
+            self._flops_per_step = None
 
     def _build_stream_runner(self, mesh, step_impl, donate, batch_size=None):
         """Segment-scanned streaming (ROADMAP r3 #3): assemble
@@ -1061,6 +1279,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         # from this (the coalesced fast path)
         self._stream_segment_steps = seg
         compiled: Dict[int, Any] = {}
+        # compute observatory: the per-fit step-phase recorder + armed
+        # capture window (set by _fit_once before this builder runs);
+        # segment paths note phases at segment granularity with steps=S
+        recorder = self._step_recorder
+        fit_capture = self._fit_capture
 
         from raydp_tpu.exchange.jax_io import (
             SegmentUploader,
@@ -1233,7 +1456,17 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     nbytes
                 )
                 obs.metrics.counter("estimator.stream.segments").inc()
+                t_up = time.perf_counter()
                 dx, dy = uploader.upload(hx, hy)
+                # producer-side H2D dispatch wall, normalized per-step by
+                # the segment's REAL batch count (hy is stacked [S, B] on
+                # both producer paths — the tail segment is shorter than
+                # seg); a lost cross-thread race costs one sample, like
+                # every other lock-free instrument
+                recorder.note(
+                    "h2d", time.perf_counter() - t_up,
+                    steps=max(1, hy.shape[0]),
+                )
                 stats["staging_copies"] = uploader.staging_copies
                 return dx, dy
 
@@ -1415,8 +1648,22 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             params, opt_state, xb, yb
                         ).compile()
                     self.compile_seconds_ += cspan.duration
+                    self._note_step_flops_abstract(
+                        scan_step, params, opt_state,
+                        _fmap(
+                            lambda a: jax.ShapeDtypeStruct(
+                                a.shape[1:], a.dtype
+                            ),
+                            xb,
+                        ),
+                        jax.ShapeDtypeStruct(yb.shape[1:], yb.dtype),
+                    )
+                t_c = time.perf_counter()
                 params, opt_state, loss_sum = compiled[length](
                     params, opt_state, xb, yb
+                )
+                recorder.note(
+                    "compute", time.perf_counter() - t_c, steps=length
                 )
                 loss_total = (
                     loss_sum if loss_total is None else loss_total + loss_sum
@@ -1429,7 +1676,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 ):
                     # same queue-depth cap as _consume: multi-epoch cached
                     # fits must not enqueue unbounded async dispatches
+                    t_s = time.perf_counter()
                     jax.block_until_ready(loss_total)
+                    recorder.note("sync", time.perf_counter() - t_s)
             if loss_total is None:
                 loss_total = jnp.zeros((), jnp.float32)
             return params, opt_state, loss_total, done
@@ -1456,6 +1705,11 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                 if isinstance(item, BaseException):
                     raise item
                 xb, yb = item
+                # per-step by the segment's REAL batch count (tail
+                # segments are shorter than seg)
+                recorder.note(
+                    "ingest", idle, steps=max(1, _f0(xb).shape[0])
+                )
                 if cache is not None and not cache_ready["ok"]:
                     cache_bytes += _f_nbytes(xb) + yb.nbytes
                     if cache_bytes > cache_budget:
@@ -1476,9 +1730,27 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             params, opt_state, xb, yb
                         ).compile()
                     self.compile_seconds_ += cspan.duration
+                    self._note_step_flops_abstract(
+                        scan_step, params, opt_state,
+                        _fmap(
+                            lambda a: jax.ShapeDtypeStruct(
+                                a.shape[1:], a.dtype
+                            ),
+                            xb,
+                        ),
+                        jax.ShapeDtypeStruct(yb.shape[1:], yb.dtype),
+                    )
+                if fit_capture is not None:
+                    fit_capture.begin_steps()
+                t_c = time.perf_counter()
                 params, opt_state, loss_sum = compiled[length](
                     params, opt_state, xb, yb
                 )
+                recorder.note(
+                    "compute", time.perf_counter() - t_c, steps=length
+                )
+                if fit_capture is not None:
+                    fit_capture.note_step(length)
                 loss_total = loss_total + loss_sum
                 done += length
                 if save_every is not None and done % save_every == 0:
@@ -1492,7 +1764,9 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     # sync_every_steps, counted in DISPATCHES here —
                     # undrained queues degrade tunneled PJRT transports;
                     # see __init__)
+                    t_s = time.perf_counter()
                     jax.block_until_ready(loss_total)
+                    recorder.note("sync", time.perf_counter() - t_s)
             return params, opt_state, loss_total, done
 
         return run
@@ -1556,6 +1830,27 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
         save_every = self.save_every_steps if self.checkpoint_dir else None
         seg_cap = min(save_every or steps_per_epoch, steps_per_epoch)
         compiled: Dict[int, Any] = {}
+        # compute observatory (set by _fit_once before this builder runs):
+        # scan dispatches note phases at segment granularity
+        recorder = self._step_recorder
+        fit_capture = self._fit_capture
+
+        def _note_flops(params, opt_state):
+            """Single-step flops donors at this fit's batch shapes (the
+            scan executables can't be read directly — cost analysis counts
+            a scan body once)."""
+            self._note_step_flops_abstract(
+                step_impl, params, opt_state,
+                _fmap(
+                    lambda a: jax.ShapeDtypeStruct(
+                        (batch_size,) + a.shape[1:], np.dtype(a.dtype)
+                    ),
+                    feats,
+                ),
+                jax.ShapeDtypeStruct(
+                    (batch_size,) + labs.shape[1:], np.dtype(labs.dtype)
+                ),
+            )
 
         def _order(seed):
             order = np.arange(n)
@@ -1618,7 +1913,17 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             .compile()
                         )
                     self.compile_seconds_ += cspan.duration
-                return compiled[length](params, opt_state, xs_dev, ys_dev, perm)
+                    _note_flops(params, opt_state)
+                if fit_capture is not None:
+                    fit_capture.begin_steps()
+                t_c = time.perf_counter()
+                out = compiled[length](params, opt_state, xs_dev, ys_dev, perm)
+                recorder.note(
+                    "compute", time.perf_counter() - t_c, steps=length
+                )
+                if fit_capture is not None:
+                    fit_capture.note_step(length)
+                return out
 
         else:
             jitted = partial_jit(
@@ -1627,6 +1932,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
 
             def run_segment(params, opt_state, order, start, length):
                 sel = order[start * batch_size : (start + length) * batch_size]
+                t_h = time.perf_counter()
                 xb = _put_stacked_batch(
                     mesh,
                     _fmap(
@@ -1642,13 +1948,26 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                     labs[sel].reshape((length, batch_size) + labs.shape[1:]),
                     shard_direct=self.shard_direct,
                 )
+                recorder.note(
+                    "h2d", time.perf_counter() - t_h, steps=length
+                )
                 if length not in compiled:
                     with _compile_span(length) as cspan:
                         compiled[length] = jitted.lower(
                             params, opt_state, xb, yb
                         ).compile()
                     self.compile_seconds_ += cspan.duration
-                return compiled[length](params, opt_state, xb, yb)
+                    _note_flops(params, opt_state)
+                if fit_capture is not None:
+                    fit_capture.begin_steps()
+                t_c = time.perf_counter()
+                out = compiled[length](params, opt_state, xb, yb)
+                recorder.note(
+                    "compute", time.perf_counter() - t_c, steps=length
+                )
+                if fit_capture is not None:
+                    fit_capture.note_step(length)
+                return out
 
         def run_epoch(params, opt_state, seed, start_step=0, save_cb=None):
             order = _order(seed)
@@ -1721,6 +2040,7 @@ class JaxEstimator(EstimatorInterface, EtlEstimatorInterface):
                             .compile()
                         )
                     self.compile_seconds_ += cspan.duration
+                    _note_flops(params, opt_state)
                 params, opt_state, losses = compiled[key](
                     params, opt_state, xs_dev, ys_dev, perms
                 )

@@ -446,6 +446,13 @@ class EtlSession:
         self._dyn_sustained = max(
             1, int(self.configs.get("etl.dynamicAllocation.sustainedStages", 1))
         )
+        #   etl.dynamicAllocation.maxMemPressure (default 0.95): scale-out
+        #   is held while host memory pressure (the mem.pressure watermark
+        #   gauge) exceeds this — same veto shape (and default) as the
+        #   serve autoscaler's serve.autoscale.max_mem_pressure
+        self._dyn_max_mem_pressure = float(
+            self.configs.get("etl.dynamicAllocation.maxMemPressure", 0.95)
+        )
         self._wide_streak = 0
         self._last_stage_ts = time.monotonic()
         self._dealloc_stop = threading.Event()
@@ -594,6 +601,30 @@ class EtlSession:
 
         return explain_last_query(session=self, top_k=top_k)
 
+    def profile_fit(self, steps: int = 16, out_dir: Optional[str] = None,
+                    jax_trace: bool = True):
+        """Arm an on-demand fit capture window (obs/profiler.py)::
+
+            with session.profile_fit(steps=32) as cap:
+                estimator.fit_on_etl(df)
+            cap.result()  # spans.json + jax trace dir under artifacts/
+
+        The deep (``jax.profiler``) trace covers the first ``steps`` train
+        steps and falls back to span-only capture where the backend can't
+        trace; the estimator's step paths drive the budget."""
+        from raydp_tpu.obs.profiler import profile_fit
+
+        return profile_fit(steps=steps, out_dir=out_dir, jax_trace=jax_trace)
+
+    def mem_pressure(self, window_s: float = 10.0) -> float:
+        """This driver's host memory pressure in [0, 1] (the windowed max
+        of the ``mem.pressure`` series with the live gauge as floor) — the
+        signal the elasticity policy and serve autoscaler consult before
+        growing a pool (docs/observability.md "Memory watermark plane")."""
+        from raydp_tpu.obs.profiler import current_mem_pressure
+
+        return current_mem_pressure(window_s=window_s)
+
     # ------------------------------------------------------------------
     # dynamic allocation (reference doRequestTotalExecutors/doKillExecutors,
     # RayCoarseGrainedSchedulerBackend.scala:229-252)
@@ -637,6 +668,16 @@ class EtlSession:
             if self._wide_streak < self._dyn_sustained:
                 return  # one wide stage is a burst, not sustained depth
             try:
+                # memory watermark plane: a sustained-wide stage does not
+                # justify forking executors into a host already out of
+                # memory headroom (same veto shape as the serve autoscaler)
+                from raydp_tpu.obs.profiler import current_mem_pressure
+
+                if current_mem_pressure() > self._dyn_max_mem_pressure:
+                    from raydp_tpu.obs import metrics
+
+                    metrics.counter("etl.scale_out_vetoed_mem").inc()
+                    return
                 self.request_total_executors(desired)
             except ClusterError:  # raydp-lint: disable=swallowed-exceptions (no capacity: the stage runs on the current pool)
                 pass  # no capacity: the stage runs on the current pool

@@ -58,8 +58,10 @@ __all__ = [
     "log",
     "metrics",
     "mint_context",
+    "profile_fit",
     "query_local_series",
     "record_span",
+    "sample_memory",
     "set_process_role",
     "span",
     "use_context",
@@ -99,3 +101,20 @@ def query_local_series(name: str, window_s: float = 60.0, labels=None):
     from raydp_tpu.obs.timeseries import query_local
 
     return query_local(name, window_s, labels)
+
+
+def profile_fit(steps: int = 16, out_dir=None, jax_trace: bool = True):
+    """Arm a bounded fit capture window (obs/profiler.py): the jax deep
+    trace covers the first ``steps`` train steps, the span capture the
+    whole ``with`` body. Lazy import: the profiler touches jax on demand."""
+    from raydp_tpu.obs.profiler import profile_fit as _profile_fit
+
+    return _profile_fit(steps=steps, out_dir=out_dir, jax_trace=jax_trace)
+
+
+def sample_memory(force: bool = False):
+    """Sample this process's memory watermark plane now (obs/profiler.py);
+    normally rides every telemetry flush tick automatically."""
+    from raydp_tpu.obs.profiler import sample_memory as _sample
+
+    return _sample(force=force)

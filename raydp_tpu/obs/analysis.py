@@ -49,6 +49,7 @@ _CATEGORY_RULES: Tuple[Tuple[str, str], ...] = (
     ("compute", "compute"),
     ("replica_infer", "compute"),
     ("replica_compile", "compute"),
+    ("compile", "compile"),
     ("estimator.step", "compute"),
     ("estimator.epoch", "compute"),
     ("executor.task", "compute"),
@@ -131,6 +132,9 @@ def _phase_split(node: _Node, lo: int, hi: int) -> Optional[List[dict]]:
     """Split a leaf stage span into synthetic segments from its phase args
     (dispatch envelope around the server's read/compute/emit window)."""
     args = node.record.get("args") or {}
+    step_split = _step_phase_split(node, args, lo, hi)
+    if step_split is not None:
+        return step_split
     phases = [
         ("decode", float(args.get("read_s", 0.0))),
         ("compute", float(args.get("compute_s", 0.0))),
@@ -160,6 +164,51 @@ def _phase_split(node: _Node, lo: int, hi: int) -> Optional[List[dict]]:
     if cursor < hi:
         segments.append(_segment(node, cursor, hi, "compute",
                                  f"{name}:server"))
+    return segments
+
+
+def _step_phase_split(node: _Node, args: dict, lo: int,
+                      hi: int) -> Optional[List[dict]]:
+    """Split a leaf EPOCH span by the step profiler's phase totals
+    (``ingest_s``/``h2d_s``/``compute_s``/``sync_s`` args, obs/profiler.py)
+    into the compute-plane categories — ``explain_last_fit`` gets the same
+    fine-grained attribution queries get from the stage phase args. Time
+    the phases don't cover stays the epoch's own (named) category.
+
+    Gated on the step profiler's OWN keys (``ingest_s``/``h2d_s``/
+    ``sync_s``): ``compute_s`` alone must not claim a planner stage span,
+    whose read/compute/emit split belongs to the server-phase arm."""
+    if not any(k in args for k in ("ingest_s", "h2d_s", "sync_s")):
+        return None
+    phases = [
+        ("ingest", float(args.get("ingest_s", 0.0))),
+        ("h2d", float(args.get("h2d_s", 0.0))),
+        ("compute", float(args.get("compute_s", 0.0))),
+        ("sync", float(args.get("sync_s", 0.0))),
+    ]
+    covered_s = sum(seconds for _, seconds in phases)
+    if covered_s <= 0.0:
+        return None
+    total_us = hi - lo
+    covered_us = min(int(covered_s * 1e6), total_us)
+    scale = covered_us / (covered_s * 1e6)
+    name = node.record.get("name", "span")
+    segments: List[dict] = []
+    cursor = lo
+    for label, seconds in phases:
+        if seconds <= 0.0:
+            continue
+        width = int(seconds * 1e6 * scale)
+        if width <= 0:
+            continue
+        segments.append(_segment(node, cursor, min(cursor + width, hi),
+                                 label, f"{name}:{label}"))
+        cursor += width
+    if cursor < hi:
+        # epoch time outside the measured phases (shuffle, bookkeeping):
+        # the epoch's own category — named, honest about coverage
+        segments.append(_segment(node, cursor, hi, categorize(name),
+                                 f"{name}:overhead"))
     return segments
 
 

@@ -30,16 +30,31 @@ class Counter:
 
 
 class Gauge:
-    __slots__ = ("value",)
+    """Last-set value, with an OPT-IN high-watermark mode: call
+    ``set_watermark`` instead of ``set`` and the snapshot additionally
+    carries ``max`` — the peak ever set — which the time-series layer fans
+    out as a ``<name>.max`` series (the memory plane's watermark gauges).
+    Plain ``set`` leaves the snapshot byte-identical to the old shape."""
+
+    __slots__ = ("value", "_max")
 
     def __init__(self):
         self.value = 0.0
+        self._max = None  # armed by the first set_watermark
 
     def set(self, value: float) -> None:
         self.value = float(value)
 
+    def set_watermark(self, value: float) -> None:
+        value = float(value)
+        self.value = value
+        if self._max is None or value > self._max:
+            self._max = value
+
     def snapshot(self):
-        return {"type": "gauge", "value": self.value}
+        if self._max is None:
+            return {"type": "gauge", "value": self.value}
+        return {"type": "gauge", "value": self.value, "max": self._max}
 
 
 class Histogram:

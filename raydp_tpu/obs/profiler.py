@@ -1,0 +1,446 @@
+"""Compute observatory: step profiler, capture windows, memory watermarks.
+
+PR 14 made the control plane observable; this module watches the COMPUTE
+plane — the half of the system ROADMAP item 2's kernel work will be
+measured with (the xprof/JAX-profiler role in the TPU ecosystem, the
+Dapper-style complement to request tracing). Three pieces:
+
+- **Step profiler** (:class:`StepPhaseRecorder`): always-on per-step phase
+  decomposition of a fit — host decode/ingest wait, H2D upload, jitted
+  compute, device sync — feeding ``estimator.step.{ingest,h2d,compute,
+  sync}_ms`` histograms into the PR 14 TSDB (scrapeable mid-fit). The
+  instruments are the registry's lock-free histograms; overhead is gated
+  ≤5% on the fit step p50 in perf_smoke (``fit_profile_probe``), and
+  ``RAYDP_TPU_STEP_PROFILER=0`` turns the recorder into a shared no-op.
+- **Capture window** (:class:`CaptureWindow` / :func:`profile_fit`): an
+  on-demand deep capture — wraps ``jax.profiler`` start/stop_trace when
+  the backend supports it, and ALWAYS collects the obs span records of the
+  wrapped region (span-only capture is the CPU fallback, never a failure).
+  Artifacts land under :func:`artifacts_dir` (gitignored ``artifacts/``).
+- **Memory watermark plane** (:func:`sample_memory`): per-process RSS,
+  /dev/shm namespace live bytes, device live-array bytes, and a
+  ``mem.pressure`` fraction — sampled on the existing obs flush ticks (the
+  tracing layer calls :func:`sample_memory` before every snapshot ship),
+  recorded as high-watermark gauges so the TSDB carries both the live
+  value and the peak (``mem.rss_bytes`` / ``mem.rss_bytes.max`` series).
+  Crash dossiers attach the per-process ``mem.*`` tails; the elasticity
+  and serve-autoscaler controllers read ``mem.pressure`` before growing.
+
+Stdlib-only at import (jax strictly on demand, and NEVER imported by the
+memory sampler — a ``python -S`` worker without jax must flush cleanly).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from raydp_tpu.obs.metrics import metrics
+
+STEP_PROFILER_ENV = "RAYDP_TPU_STEP_PROFILER"
+ARTIFACTS_DIR_ENV = "RAYDP_TPU_ARTIFACTS_DIR"
+JAX_PROFILER_ENV = "RAYDP_TPU_JAX_PROFILER"
+
+STEP_PHASES = ("ingest", "h2d", "compute", "sync")
+
+_step_profiler_on = os.environ.get(STEP_PROFILER_ENV, "1") not in (
+    "0", "false", "False"
+)
+
+
+def step_profiler_enabled() -> bool:
+    return _step_profiler_on
+
+
+def set_step_profiler(on: bool) -> None:
+    """Bench/test hook (the ``fit_profile_probe`` A/B arm); prefer the env
+    var so spawned processes agree."""
+    global _step_profiler_on
+    _step_profiler_on = bool(on)
+
+
+def artifacts_dir(*sub: str) -> str:
+    """The gitignored artifact root (``artifacts/`` or
+    ``RAYDP_TPU_ARTIFACTS_DIR``), with optional subdirs, created on
+    demand — bench traces, profiler captures, and tool outputs all land
+    here instead of littering the repo root."""
+    root = os.environ.get(ARTIFACTS_DIR_ENV, "artifacts")
+    path = os.path.join(root, *sub) if sub else root
+    os.makedirs(path, exist_ok=True)
+    return path
+
+
+# ---------------------------------------------------------------------------
+# step profiler
+# ---------------------------------------------------------------------------
+
+
+class _NoopRecorder:
+    """Shared do-nothing recorder for the disabled arm: the per-step call
+    sites stay branch-free (one attr call, two pass statements)."""
+
+    __slots__ = ()
+    enabled = False
+    steps = 0
+
+    def note(self, phase: str, seconds: float, steps: int = 1) -> None:
+        pass
+
+    def totals(self) -> Dict[str, float]:
+        return {}
+
+
+_NOOP_RECORDER = _NoopRecorder()
+
+
+class StepPhaseRecorder:
+    """Accumulates one fit's per-step phase decomposition.
+
+    ``note(phase, seconds, steps)`` charges ``seconds`` of wall time to a
+    phase across ``steps`` train steps: the per-step loop calls it once per
+    step, the segment-scanned paths once per segment with ``steps=S`` (the
+    histogram then records the per-step average for that segment — the
+    honest granularity when S steps ride one dispatch). Instruments are
+    resolved ONCE (the per-step hot path is a float add + a lock-free
+    histogram observe)."""
+
+    __slots__ = ("enabled", "steps", "_totals", "_hists")
+
+    def __init__(self):
+        self.enabled = True
+        self.steps = 0
+        self._totals = {phase: 0.0 for phase in STEP_PHASES}
+        self._hists = {
+            phase: metrics.histogram(f"estimator.step.{phase}_ms")
+            for phase in STEP_PHASES
+        }
+
+    def note(self, phase: str, seconds: float, steps: int = 1) -> None:
+        if seconds < 0.0:
+            seconds = 0.0
+        self._totals[phase] += seconds
+        if phase == "compute":
+            self.steps += steps
+        self._hists[phase].observe(seconds / max(steps, 1) * 1000.0)
+
+    def totals(self) -> Dict[str, float]:
+        return dict(self._totals)
+
+
+def step_recorder() -> Any:
+    """A fresh recorder for one fit — or the shared no-op when the step
+    profiler is off."""
+    return StepPhaseRecorder() if _step_profiler_on else _NOOP_RECORDER
+
+
+# ---------------------------------------------------------------------------
+# capture window (on-demand deep profile)
+# ---------------------------------------------------------------------------
+
+_capture_lock = threading.Lock()
+_armed_capture: Optional["CaptureWindow"] = None
+
+
+def armed_capture() -> Optional["CaptureWindow"]:
+    """The capture window the next (or current) fit should feed, if any —
+    the estimator's step paths poll this once per fit."""
+    return _armed_capture
+
+
+class CaptureWindow:
+    """On-demand deep capture of a compute region.
+
+    Two modes share one class:
+
+    - ``steps=None`` (the serve replica's ``profile()``): the window brackets
+      the ``with`` body — jax trace starts at enter, stops at exit.
+    - ``steps=N`` (``session.profile_fit``): the window ARMS itself; the
+      estimator's step paths call :meth:`begin_steps` at the first step and
+      :meth:`note_step` per step, and the jax trace stops after N steps
+      while the fit runs on — a bounded capture of a steady-state slice.
+
+    Either way the obs span records of the window are collected on the
+    entering thread (span-only capture — the guaranteed floor when
+    ``jax.profiler`` is unavailable, disabled via ``RAYDP_TPU_JAX_PROFILER=0``,
+    or the backend refuses to trace) and written to
+    ``<out_dir>/spans.json`` at exit. ``result()`` summarizes."""
+
+    def __init__(self, steps: Optional[int] = None,
+                 out_dir: Optional[str] = None, jax_trace: bool = True):
+        from raydp_tpu.obs import tracing
+
+        self.steps = int(steps) if steps else None
+        self.out_dir = out_dir or os.path.join(
+            artifacts_dir("profiles"), time.strftime("%Y%m%dT%H%M%S")
+        )
+        self._want_jax = bool(jax_trace) and os.environ.get(
+            JAX_PROFILER_ENV, "1"
+        ) not in ("0", "false", "False")
+        self._collector = tracing.collect()
+        self.records: List[dict] = []
+        self.jax_trace_dir: Optional[str] = None
+        self._jax_active = False
+        self._budget_done = False  # step budget exhausted: stay stopped
+        self._seen_steps = 0
+        self.path: Optional[str] = None
+
+    # -- jax trace half --------------------------------------------------
+
+    def _start_jax(self) -> None:
+        if not self._want_jax or self._jax_active:
+            return
+        try:
+            import jax
+
+            trace_dir = os.path.join(self.out_dir, "jax_trace")
+            os.makedirs(trace_dir, exist_ok=True)
+            jax.profiler.start_trace(trace_dir)
+            self._jax_active = True
+            self.jax_trace_dir = trace_dir
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (no jax / backend refuses to trace: span-only capture is the documented fallback)
+            self._want_jax = False
+
+    def _stop_jax(self) -> None:
+        if not self._jax_active:
+            return
+        self._jax_active = False
+        try:
+            import jax
+
+            jax.profiler.stop_trace()
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (a failed stop must not discard the span capture)
+            self.jax_trace_dir = None
+
+    # -- fit-step protocol (driven by the estimator) ---------------------
+
+    def begin_steps(self) -> None:
+        """First train step of the captured fit reached: start the deep
+        trace (bounded by ``steps``). Called before EVERY dispatch by the
+        segment paths — once the budget is spent this must stay a no-op,
+        or the trace would restart/stop around every remaining segment."""
+        if self.steps is not None and not self._budget_done:
+            self._start_jax()
+
+    def note_step(self, n: int = 1) -> None:
+        if self.steps is None:
+            return
+        self._seen_steps += n
+        if self._seen_steps >= self.steps and not self._budget_done:
+            self._budget_done = True
+            self._stop_jax()
+
+    # -- context manager -------------------------------------------------
+
+    def __enter__(self) -> "CaptureWindow":
+        global _armed_capture
+        with _capture_lock:
+            if _armed_capture is not None:
+                raise RuntimeError("another profiler capture is active")
+            _armed_capture = self
+        self.records = self._collector.__enter__()
+        if self.steps is None:
+            self._start_jax()
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        global _armed_capture
+        self._stop_jax()
+        self._collector.__exit__(*exc)
+        with _capture_lock:
+            if _armed_capture is self:
+                _armed_capture = None
+        try:
+            os.makedirs(self.out_dir, exist_ok=True)
+            path = os.path.join(self.out_dir, "spans.json")
+            with open(path, "w") as f:
+                json.dump(self.records, f, default=str)
+            self.path = path
+        except OSError:  # raydp-lint: disable=swallowed-exceptions (a full disk must not fail the profiled fit; the records stay in memory)
+            self.path = None
+        return False
+
+    def result(self) -> dict:
+        return {
+            "out_dir": self.out_dir,
+            "spans_path": self.path,
+            "span_records": len(self.records),
+            "jax_trace_dir": self.jax_trace_dir,
+            "steps_captured": self._seen_steps if self.steps else None,
+        }
+
+
+def profile_fit(steps: int = 16, out_dir: Optional[str] = None,
+                jax_trace: bool = True) -> CaptureWindow:
+    """Arm a bounded fit capture::
+
+        with session.profile_fit(steps=32) as cap:
+            estimator.fit_on_etl(df)
+        print(cap.result())
+
+    The deep (jax) trace covers the first ``steps`` train steps; the span
+    capture covers the whole window."""
+    return CaptureWindow(steps=steps, out_dir=out_dir, jax_trace=jax_trace)
+
+
+def capture(out_dir: Optional[str] = None,
+            jax_trace: bool = True) -> CaptureWindow:
+    """Bracket-style capture (no step budget): used by the serve replica's
+    ``profile()`` and any tool that wants one region deep-traced."""
+    return CaptureWindow(steps=None, out_dir=out_dir, jax_trace=jax_trace)
+
+
+# ---------------------------------------------------------------------------
+# fit attribution (the analyzer over the fit span tree)
+# ---------------------------------------------------------------------------
+
+
+def explain_fit(records: List[dict], top_k: int = 5) -> dict:
+    """Critical-path attribution of one fit's span records (the PR 14
+    analyzer over the ``estimator.fit`` tree: epoch/compile/eval children,
+    epoch leaves phase-split by the step profiler's ingest/h2d/compute/sync
+    args). ``JaxEstimator.explain_last_fit()`` is the instance-method
+    spelling."""
+    from raydp_tpu.obs.analysis import attribute, format_report
+
+    report = attribute(records, root_name="estimator.fit", top_k=top_k)
+    report["text"] = format_report(report)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# memory watermark plane
+# ---------------------------------------------------------------------------
+
+MEM_SAMPLE_MIN_INTERVAL_S = 1.0
+
+_mem_lock = threading.Lock()
+_last_mem_sample = 0.0
+_page_size = os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+
+
+def _read_rss_bytes() -> Optional[int]:
+    try:
+        with open("/proc/self/statm") as f:
+            return int(f.read().split()[1]) * _page_size
+    except (OSError, ValueError, IndexError):
+        try:
+            import resource
+
+            # ru_maxrss is the PEAK (KB on linux) — an acceptable stand-in
+            # where /proc is absent; the watermark gauge makes peak vs live
+            # explicit either way
+            return resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (no rss source on this platform: the series is simply absent)
+            return None
+
+
+def _shm_live_bytes() -> Optional[int]:
+    """Live bytes of this node's /dev/shm namespace (segments are named
+    ``rtpu-<ns>-<id>``; an empty namespace owns the un-prefixed pool)."""
+    ns = os.environ.get("RAYDP_TPU_SHM_NS", "")
+    prefix = f"rtpu-{ns}-" if ns else "rtpu-"
+    total = 0
+    try:
+        with os.scandir("/dev/shm") as entries:
+            for entry in entries:
+                if not entry.name.startswith(prefix):
+                    continue
+                try:
+                    total += entry.stat().st_size
+                except OSError:  # raydp-lint: disable=swallowed-exceptions (segment unlinked mid-scan)
+                    continue
+    except OSError:
+        return None
+    return total
+
+
+def _device_live_bytes() -> Optional[int]:
+    """Device live-array bytes — ONLY when jax is already imported (the
+    sampler must never be the thing that drags jax into a worker)."""
+    jax = sys.modules.get("jax")
+    if jax is None:
+        return None
+    try:
+        stats = jax.devices()[0].memory_stats() or {}
+        in_use = stats.get("bytes_in_use")
+        if in_use is not None:
+            return int(in_use)
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (backend without memory stats: fall through to live_arrays)
+        pass
+    try:
+        return int(sum(a.nbytes for a in jax.live_arrays()))
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (no live-array introspection on this backend either)
+        return None
+
+
+def _mem_pressure() -> Optional[float]:
+    """Host memory pressure in [0, 1]: 1 - MemAvailable/MemTotal."""
+    try:
+        total = avail = None
+        with open("/proc/meminfo") as f:
+            for line in f:
+                if line.startswith("MemTotal:"):
+                    total = float(line.split()[1])
+                elif line.startswith("MemAvailable:"):
+                    avail = float(line.split()[1])
+                if total is not None and avail is not None:
+                    break
+        if not total or avail is None:
+            return None
+        return max(0.0, min(1.0, 1.0 - avail / total))
+    except (OSError, ValueError, IndexError):
+        return None
+
+
+def sample_memory(force: bool = False) -> Optional[dict]:
+    """Sample this process's memory plane into the registry (high-watermark
+    gauges ``mem.{rss,shm,device}_bytes`` + ``mem.pressure``). Rides every
+    obs flush tick (tracing.flush calls this first), self-throttled to
+    :data:`MEM_SAMPLE_MIN_INTERVAL_S`; returns the sample dict, or None
+    when throttled."""
+    global _last_mem_sample
+    now = time.monotonic()
+    with _mem_lock:
+        if not force and now - _last_mem_sample < MEM_SAMPLE_MIN_INTERVAL_S:
+            return None
+        _last_mem_sample = now
+    sample: Dict[str, float] = {}
+    rss = _read_rss_bytes()
+    if rss is not None:
+        sample["rss_bytes"] = float(rss)
+        metrics.gauge("mem.rss_bytes").set_watermark(rss)
+    shm = _shm_live_bytes()
+    if shm is not None:
+        sample["shm_bytes"] = float(shm)
+        metrics.gauge("mem.shm_bytes").set_watermark(shm)
+    device = _device_live_bytes()
+    if device is not None:
+        sample["device_bytes"] = float(device)
+        metrics.gauge("mem.device_bytes").set_watermark(device)
+    pressure = _mem_pressure()
+    if pressure is not None:
+        sample["pressure"] = pressure
+        metrics.gauge("mem.pressure").set_watermark(pressure)
+    return sample
+
+
+def current_mem_pressure(window_s: float = 10.0) -> float:
+    """The controllers' read of host memory pressure: the max over this
+    process's recent windowed ``mem.pressure`` series with the live gauge
+    as the freshness floor (the serve autoscaler and the elasticity policy
+    consult this before growing a pool)."""
+    sample_memory()
+    live = metrics.gauge("mem.pressure").value
+    try:
+        from raydp_tpu.obs import timeseries as _ts
+
+        windowed = _ts.windowed_local("mem.pressure", window_s=window_s)
+        if windowed["series"] and windowed["max"] is not None:
+            return max(live, windowed["max"])
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (the live gauge alone is a valid pressure read)
+        pass
+    return live

@@ -129,12 +129,19 @@ class SeriesStore:
                 flat.append((raw_name, "counter", float(snap.get("value", 0.0))))
             elif kind == "gauge":
                 flat.append((raw_name, "gauge", float(snap.get("value", 0.0))))
+                if snap.get("max") is not None:
+                    # high-watermark gauges (memory plane) fan a .max peak
+                    # series out alongside the live value
+                    flat.append((f"{raw_name}.max", "gauge",
+                                 float(snap["max"])))
             elif kind == "histogram":
                 flat.append((f"{raw_name}.count", "counter",
                              float(snap.get("count", 0))))
                 flat.append((f"{raw_name}.sum", "counter",
                              float(snap.get("sum", 0.0))))
-                for q in ("p50", "p99"):
+                # max is the watermark axis (per-step H2D spikes, memory
+                # highs): a gauge series like the quantiles
+                for q in ("p50", "p99", "max"):
                     if snap.get(q) is not None:
                         flat.append((f"{raw_name}.{q}", "gauge",
                                      float(snap[q])))

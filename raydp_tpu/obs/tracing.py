@@ -378,6 +378,17 @@ def flush() -> bool:
     global _dropped
     from raydp_tpu.obs.metrics import metrics
 
+    # memory watermark plane: every flush tick samples this process's
+    # rss / shm-namespace / device bytes + pressure into the registry
+    # FIRST, so the snapshot shipped below carries fresh mem.* gauges
+    # (self-throttled to ~1s inside sample_memory; never raises)
+    try:
+        from raydp_tpu.obs.profiler import sample_memory
+
+        sample_memory()
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (the memory sampler must never block a telemetry flush)
+        pass
+
     spans = drain_local()
     snapshot = metrics.snapshot()
     if not spans and not snapshot:

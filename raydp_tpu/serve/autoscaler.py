@@ -64,10 +64,15 @@ class ServeController:
         windowed = _ts.windowed_local("serve.p99_ms", window_s=window_s)
         if windowed["series"]:
             p99 = max(p99, windowed["max"] or 0.0)
+        from raydp_tpu.obs.profiler import current_mem_pressure
+
         return {
             "queue_rows": obs.metrics.gauge("serve.queue_depth").value,
             "inflight": self._deployment.batcher.inflight_total(),
             "p99_ms": p99,
+            # memory watermark plane: scale-out is vetoed while the host
+            # is under memory pressure (tick() reads this)
+            "mem_pressure": current_mem_pressure(window_s=window_s),
         }
 
     def _run(self) -> None:
@@ -108,6 +113,15 @@ class ServeController:
             self._hot_streak >= self._conf.sustained_ticks
             and replicas < self._conf.max_replicas
         ):
+            pressure = signals.get("mem_pressure", 0.0) or 0.0
+            if pressure > self._conf.max_mem_pressure:
+                # hot but the HOST is out of memory headroom: forking a
+                # replica would trade latency for an OOM — hold, keep the
+                # streak hot, and leave a visible marker
+                obs.metrics.counter("serve.scale_out_vetoed_mem").inc()
+                obs.instant("serve.autoscale_veto_mem",
+                            mem_pressure=round(pressure, 4))
+                return None
             self._hot_streak = 0
             deployment.scale_to(replicas + 1)  # counts serve.scale_out
             obs.instant("serve.autoscale_out", replicas=replicas + 1,

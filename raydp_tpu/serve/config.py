@@ -60,6 +60,10 @@ class ServeConf:
     sustained_ticks: int = 3  # the etl.dynamicAllocation.sustainedStages shape
     target_queue_per_replica: float = 8.0  # rows of sustained backlog each
     slo_p99_ms: Optional[float] = None  # latency SLO; breach => scale out
+    # scale-out is REFUSED while host memory pressure (the mem.pressure
+    # watermark gauge, obs/profiler.py) exceeds this — a hot deployment
+    # must not fork replicas into an OOM (conf: autoscale.max_mem_pressure)
+    max_mem_pressure: float = 0.95
     # -- replicas -------------------------------------------------------
     replica_light: bool = True  # zygote warm fork (python -S); see docs
     replica_max_concurrency: int = 4
@@ -117,6 +121,7 @@ class ServeConf:
             target_queue_per_replica=float(
                 get("autoscale.target_queue_per_replica", 8.0)
             ),
+            max_mem_pressure=float(get("autoscale.max_mem_pressure", 0.95)),
             slo_p99_ms=(
                 float(get("slo_p99_ms")) if get("slo_p99_ms") is not None
                 else None

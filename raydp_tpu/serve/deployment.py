@@ -259,6 +259,18 @@ class Deployment:
             snapshot = list(self._handles)
         return [h.info.remote().result() for h in snapshot]
 
+    def profile(self, payload=None) -> dict:
+        """Capture one replica's warm inference under the compute
+        observatory (``ModelReplica.profile``): the first live replica
+        runs a deep (jax-profiler when available, span-only otherwise)
+        capture of one inference and returns the capture summary —
+        on-demand, never on the request path."""
+        with self._lock:
+            snapshot = list(self._handles)
+        if not snapshot:
+            raise RuntimeError("no live replicas to profile")
+        return snapshot[0].profile.remote(payload).result()
+
     def stats(self) -> dict:
         out = self.batcher.stats()
         out["target_replicas"] = self._target

@@ -214,6 +214,67 @@ class ModelReplica:
                 state.compiled_for(pad_rows(rows, int(bucket)))
         return len(state.compiled)
 
+    def profile(self, payload=None, out_dir: Optional[str] = None) -> dict:
+        """On-demand compute capture of ONE warm inference (the serve
+        plane's half of the compute observatory, obs/profiler.py): run
+        ``payload`` (default: the deployment's warm ``example``) through
+        the active generation under a capture window — ``jax.profiler``
+        deep trace when the backend supports it, span-only otherwise —
+        and return the capture summary + measured compute. The capture
+        runs in THIS replica process; artifacts land in its ``artifacts/``
+        dir (``RAYDP_TPU_ARTIFACTS_DIR`` routes them)."""
+        import time as _time
+
+        from raydp_tpu.exchange.features import as_feature_rows, pad_rows
+        from raydp_tpu.obs.profiler import capture
+
+        source = payload if payload is not None else self._spec.example
+        if source is None:
+            raise ValueError(
+                "profile() needs a payload (deployment has no example=)"
+            )
+        from raydp_tpu import obs
+
+        from raydp_tpu.exchange.features import f_slice
+
+        rows = as_feature_rows(source)
+        # route through the batcher's bucket shapes (pad to the smallest
+        # fitting bucket; an oversized payload is TRUNCATED to the largest
+        # — the serving path only ever runs bucket shapes, and a raw shape
+        # must not compile into the bucket-keyed cache of a live replica)
+        # and warm OUTSIDE the window: the capture must show one
+        # steady-state inference, not an XLA compile
+        n_rows = len(f0(rows))
+        if self._spec.buckets:
+            fitting = [
+                int(b) for b in self._spec.buckets if int(b) >= n_rows
+            ]
+            if fitting:
+                rows = pad_rows(rows, min(fitting))
+            else:
+                largest = max(int(b) for b in self._spec.buckets)
+                rows = f_slice(rows, 0, largest)
+                n_rows = largest
+        state = self._active
+        fn = state.compiled_for(rows)
+        np.asarray(fn(state.params, rows))  # uncaptured warm-up call
+        with capture(out_dir=out_dir) as cap:
+            # a real span inside the window: the span-only fallback arm
+            # captures at least the inference interval it exists to show
+            with obs.span("serve.replica_profile",
+                          fingerprint=state.fingerprint):
+                t0 = _time.perf_counter()
+                np.asarray(fn(state.params, rows))
+                compute_s = _time.perf_counter() - t0
+        result = cap.result()
+        result.update({
+            "compute_ms": round(compute_s * 1000.0, 3),
+            "rows": n_rows,
+            "batch_rows": len(f0(rows)),  # the bucket shape actually run
+            "fingerprint": state.fingerprint,
+        })
+        return result
+
     def info(self) -> dict:
         import os
 

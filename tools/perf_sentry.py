@@ -1,0 +1,359 @@
+"""Bench-regression sentry: the BENCH_r* trajectory as a machine-checked ledger.
+
+Until PR 15 the bench trajectory lived as tribal knowledge ("trust
+interleaved medians, not single samples" — the r06 lesson) and perf gates
+as hand-pinned per-release constants inside ``tools/perf_smoke.py``. This
+tool turns every committed ``BENCH_r*.json`` snapshot into one
+schema-validated **ledger** and derives, per tracked stat:
+
+- a **baseline value** — the median of the newest up-to-3 releases that
+  report the stat (median-of-releases: one noisy snapshot cannot move the
+  baseline, the ledger-level form of the interleaved-median rule);
+- a **noise band** — 2x the median absolute relative release-to-release
+  delta over the stat's history, clamped to [``MIN_BAND``, ``MAX_BAND``].
+  The floor encodes the r06 incident: a 2-core box drifts ±25% between
+  identical runs, so no stat gets a band tighter than what box noise has
+  actually produced; the clamp keeps a stat with one wild historical swing
+  from becoming ungateable.
+
+``--write`` emits ``BENCH_BASELINE.json`` (committed; ``tools/perf_smoke``
+reads its thresholds from it). ``--check`` recomputes the ledger from the
+BENCH files and fails when the NEWEST release regresses beyond baseline +
+band on any stat (direction-aware: "lower is better" stats gate upward,
+"higher is better" downward) — the CI gate. Stats a release doesn't report
+are skipped, never failed: the ledger spans releases that predate most
+probes.
+
+Usage:
+    python -m tools.perf_sentry --write   [--ledger BENCH_BASELINE.json]
+    python -m tools.perf_sentry --check   [--ledger BENCH_BASELINE.json]
+    python -m tools.perf_sentry           # print the trend table
+"""
+# raydp-lint: disable-file=print-diagnostics (standalone CI tool: its stdout IS the report, there is no obs role to tag)
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+import statistics
+import sys
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+LEDGER_FORMAT = "raydp-bench-ledger-v1"
+DEFAULT_LEDGER = "BENCH_BASELINE.json"
+
+MIN_BAND = 0.25  # the r06 floor: box noise alone produces ±25% swings
+MAX_BAND = 0.60  # one wild historical swing must not make a stat ungateable
+BASELINE_POINTS = 3  # median of the newest N reporting releases
+
+# tracked stats: name -> (dotted path into the parsed bench JSON, direction)
+# direction "higher" = regressions are DROPS, "lower" = regressions are RISES
+STATS: Dict[str, Tuple[str, str]] = {
+    "e2e_sps": ("value", "higher"),
+    "vs_baseline": ("vs_baseline", "higher"),
+    "train_vs_pure": ("detail.train_vs_pure", "higher"),
+    "etl_query_s": ("detail.etl_query_s", "lower"),
+    "burst_p50_ms": ("detail.burst_p50_ms", "lower"),
+    "burst_p99_ms": ("detail.burst_p99_ms", "lower"),
+    "plan_cache_hit_rate": ("detail.plan_cache_hit_rate", "higher"),
+    "cluster_boot_s": ("detail.cluster_boot_s", "lower"),
+    "streaming_vs_scan": ("detail.streaming_vs_scan", "higher"),
+    "streaming_hybrid_vs_scan": ("detail.streaming_hybrid_vs_scan", "higher"),
+    "consumer_idle_s": (
+        "detail.streaming_pipeline.consumer_idle_s", "lower"
+    ),
+    "dlrm_train_vs_pure": ("detail.dlrm.train_vs_pure", "higher"),
+    "serve_p99_ms": ("detail.serving_probe.p99_ms", "lower"),
+    "serve_rps": ("detail.serving_probe.sustained_rps", "higher"),
+    "tenant_p99_ratio": ("detail.tenant_isolation_probe.p99_ratio", "lower"),
+    "lm_mfu": ("detail.lm.mfu", "higher"),
+    "fit_mfu": ("detail.fit_profile_probe.mfu_live", "higher"),
+}
+
+
+# ---------------------------------------------------------------------------
+# extraction: parsed JSON when a snapshot carries it, regex over the stdout
+# tail otherwise (old snapshots truncate the front of the tail)
+# ---------------------------------------------------------------------------
+
+
+def _dotted(parsed: Optional[dict], path: str) -> Optional[float]:
+    node: Any = parsed
+    for part in path.split("."):
+        if not isinstance(node, dict):
+            return None
+        node = node.get(part)
+    if isinstance(node, bool) or not isinstance(node, (int, float)):
+        return None
+    return float(node)
+
+
+def _tail_regex(tail: str, key: str) -> Optional[float]:
+    # first occurrence is the NYCTaxi slice — the perf_smoke convention
+    found = re.search(rf'"{key}": (-?[0-9.]+)', tail)
+    try:
+        return float(found.group(1)) if found else None
+    except ValueError:
+        return None
+
+
+def _parse_snapshot(path: str) -> Tuple[Optional[int], Dict[str, float]]:
+    """(release number, {stat: value}) for one BENCH_r*.json file."""
+    with open(path) as f:
+        raw = json.load(f)
+    tail = raw.get("tail", "") or ""
+    parsed = raw.get("parsed")
+    if parsed is None:
+        for line in reversed(tail.strip().splitlines()):
+            try:
+                candidate = json.loads(line)
+            except ValueError:  # raydp-lint: disable=swallowed-exceptions (scanning the stdout tail for its one JSON line; non-JSON lines are expected)
+                continue
+            if isinstance(candidate, dict) and "metric" in candidate:
+                parsed = candidate
+                break
+    release = None
+    found = re.search(r"BENCH_r(\d+)\.json$", path)
+    if found:
+        release = int(found.group(1))
+    stats: Dict[str, float] = {}
+    for name, (dotted_path, _direction) in STATS.items():
+        value = _dotted(parsed, dotted_path)
+        if value is None:
+            value = _tail_regex(tail, dotted_path.rsplit(".", 1)[-1])
+        if value is not None:
+            stats[name] = value
+    return release, stats
+
+
+def build_ledger(repo: str = REPO) -> dict:
+    """All committed BENCH_r*.json snapshots as one ledger dict (releases
+    ordered by release number)."""
+    releases: List[dict] = []
+    for path in glob.glob(os.path.join(repo, "BENCH_r*.json")):
+        release, stats = _parse_snapshot(path)
+        if release is None or not stats:
+            continue
+        releases.append({
+            "release": f"r{release:02d}",
+            "n": release,
+            "stats": stats,
+        })
+    releases.sort(key=lambda r: r["n"])
+    return {
+        "format": LEDGER_FORMAT,
+        "directions": {name: d for name, (_p, d) in STATS.items()},
+        "releases": releases,
+        "baseline": derive_baselines(releases),
+    }
+
+
+# ---------------------------------------------------------------------------
+# trend statistics
+# ---------------------------------------------------------------------------
+
+
+def _series(releases: List[dict], stat: str) -> List[Tuple[int, float]]:
+    return [
+        (r["n"], r["stats"][stat]) for r in releases if stat in r["stats"]
+    ]
+
+
+def noise_band(values: List[float]) -> float:
+    """Noise band from successive relative deltas, clamped to
+    [MIN_BAND, MAX_BAND]. Fewer than 3 points = MAX_BAND (one delta is a
+    sample, not a distribution — exactly the single-sample trap the r06
+    incident taught)."""
+    if len(values) < 3:
+        return MAX_BAND
+    deltas = [
+        abs(b - a) / abs(a)
+        for a, b in zip(values[:-1], values[1:])
+        if a
+    ]
+    if not deltas:
+        return MAX_BAND
+    return max(MIN_BAND, min(MAX_BAND, 2.0 * statistics.median(deltas)))
+
+
+def derive_baselines(releases: List[dict]) -> Dict[str, dict]:
+    """Per-stat baseline value + noise band from the release series."""
+    out: Dict[str, dict] = {}
+    for stat, (_path, direction) in STATS.items():
+        series = _series(releases, stat)
+        if not series:
+            continue
+        values = [v for _, v in series]
+        recent = values[-BASELINE_POINTS:]
+        out[stat] = {
+            "value": statistics.median(recent),
+            "band": round(noise_band(values), 4),
+            "direction": direction,
+            "points": len(values),
+            "newest_release": f"r{series[-1][0]:02d}",
+        }
+    return out
+
+
+def check_release(stats: Dict[str, float],
+                  baseline: Dict[str, dict]) -> List[str]:
+    """Direction-aware regression check of one release's stats against the
+    baseline+band; returns human-readable failures (empty = pass)."""
+    failures: List[str] = []
+    for stat, value in stats.items():
+        ref = baseline.get(stat)
+        if ref is None or not ref.get("value"):
+            continue
+        base, band = float(ref["value"]), float(ref["band"])
+        if ref["direction"] == "lower":
+            limit = base * (1.0 + band)
+            if value > limit:
+                failures.append(
+                    f"{stat}: {value:.4g} exceeds {limit:.4g} "
+                    f"(baseline {base:.4g} + {band:.0%} noise band)"
+                )
+        else:
+            limit = base * (1.0 - band)
+            if value < limit:
+                failures.append(
+                    f"{stat}: {value:.4g} below {limit:.4g} "
+                    f"(baseline {base:.4g} - {band:.0%} noise band)"
+                )
+    return failures
+
+
+# ---------------------------------------------------------------------------
+# schema validation (the ledger is a committed contract, not a cache)
+# ---------------------------------------------------------------------------
+
+
+def validate_ledger(ledger: dict) -> None:
+    """Raise ValueError on any structural problem — a corrupt committed
+    ledger must fail loudly, not gate against garbage."""
+    if not isinstance(ledger, dict) or ledger.get("format") != LEDGER_FORMAT:
+        raise ValueError(
+            f"ledger format is not {LEDGER_FORMAT!r}: "
+            f"{ledger.get('format') if isinstance(ledger, dict) else ledger!r}"
+        )
+    releases = ledger.get("releases")
+    if not isinstance(releases, list) or not releases:
+        raise ValueError("ledger has no releases")
+    last_n = None
+    for record in releases:
+        if not isinstance(record, dict):
+            raise ValueError(f"release record is not a dict: {record!r}")
+        n = record.get("n")
+        if not isinstance(n, int):
+            raise ValueError(f"release {record.get('release')!r}: bad n={n!r}")
+        if last_n is not None and n <= last_n:
+            raise ValueError("releases are not strictly ordered by n")
+        last_n = n
+        stats = record.get("stats")
+        if not isinstance(stats, dict) or not stats:
+            raise ValueError(f"release r{n}: empty stats")
+        for key, value in stats.items():
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                raise ValueError(f"release r{n}: stat {key}={value!r} not numeric")
+    baseline = ledger.get("baseline")
+    if not isinstance(baseline, dict) or not baseline:
+        raise ValueError("ledger has no baseline section")
+    for stat, ref in baseline.items():
+        if ref.get("direction") not in ("higher", "lower"):
+            raise ValueError(f"baseline {stat}: bad direction {ref.get('direction')!r}")
+        for key in ("value", "band"):
+            value = ref.get(key)
+            if isinstance(value, bool) or not isinstance(value, (int, float)):
+                raise ValueError(f"baseline {stat}: {key}={value!r} not numeric")
+
+
+def load_baseline(ledger_path: Optional[str] = None) -> Optional[Dict[str, dict]]:
+    """The committed baseline section, validated — or None when the ledger
+    file is absent (callers keep their hardcoded fallbacks)."""
+    path = ledger_path or os.path.join(REPO, DEFAULT_LEDGER)
+    try:
+        with open(path) as f:
+            ledger = json.load(f)
+    except (OSError, ValueError):
+        return None
+    validate_ledger(ledger)
+    return ledger["baseline"]
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def format_trend(ledger: dict) -> str:
+    lines = [f"{'stat':<26} {'dir':<6} {'baseline':>12} {'band':>6} "
+             f"{'newest':>12}  trajectory"]
+    for stat, ref in sorted(ledger["baseline"].items()):
+        series = _series(ledger["releases"], stat)
+        trajectory = " ".join(f"r{n}:{v:.3g}" for n, v in series[-6:])
+        lines.append(
+            f"{stat:<26} {ref['direction']:<6} {ref['value']:>12.4g} "
+            f"{ref['band']:>6.0%} {series[-1][1]:>12.4g}  {trajectory}"
+        )
+    return "\n".join(lines)
+
+
+def main(argv: List[str]) -> int:
+    ledger_path = os.path.join(REPO, DEFAULT_LEDGER)
+    if "--ledger" in argv:
+        ledger_path = argv[argv.index("--ledger") + 1]
+    ledger = build_ledger()
+    if not ledger["releases"]:
+        print("PERF-SENTRY FAIL: no BENCH_r*.json snapshots found",
+              file=sys.stderr)
+        return 1
+    validate_ledger(ledger)
+
+    if "--write" in argv:
+        with open(ledger_path, "w") as f:
+            json.dump(ledger, f, indent=1, sort_keys=True)
+            f.write("\n")
+        print(f"wrote {ledger_path} "
+              f"({len(ledger['releases'])} releases, "
+              f"{len(ledger['baseline'])} gated stats)")
+        return 0
+
+    if "--check" in argv:
+        committed = load_baseline(ledger_path)
+        if committed is None:
+            print(
+                f"PERF-SENTRY FAIL: no committed ledger at {ledger_path} "
+                "(run --write and commit it)",
+                file=sys.stderr,
+            )
+            return 1
+        newest = ledger["releases"][-1]
+        # gate the NEWEST release against the COMMITTED baseline — the
+        # thresholds pinned when the ledger was last accepted (--write).
+        # A fresh BENCH_rNN lands, --check gates it against the prior
+        # era's bands; accepting it means re-running --write, which is a
+        # reviewed diff on BENCH_BASELINE.json — never a silent ratchet.
+        failures = check_release(newest["stats"], committed)
+        if failures:
+            for failure in failures:
+                print(
+                    f"PERF-SENTRY FAIL [{newest['release']}]: {failure}",
+                    file=sys.stderr,
+                )
+            return 1
+        print(
+            f"PERF-SENTRY OK: {newest['release']} within noise bands on "
+            f"{len(newest['stats'])} stats "
+            f"({len(ledger['releases'])} releases in ledger)"
+        )
+        return 0
+
+    print(format_trend(ledger))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

@@ -6,13 +6,17 @@ BENCH_* env vars), writes an artifact JSON holding the headline ETL numbers
 plus the full ``etl_breakdown`` and per-exchange shuffle stats, and FAILS
 when:
 
-- ``etl_query_s`` regresses more than 25% over the committed BENCH_r08
-  snapshot's value (the CI slice runs ~10x fewer rows than the snapshot's
-  run, so this is a smoke gate for gross regressions — a structural
-  slowdown in the data plane, not a ±10% noise detector);
-- the interactive-burst p50 (``burst_p50_ms``) regresses more than 25% over
-  the snapshot — the millisecond-control-plane gate (plan cache + run_plan
-  dispatch + head bypass + doorbell all sit under this number);
+- ``etl_query_s`` regresses beyond the sentry ledger's baseline + noise
+  band (``BENCH_BASELINE.json``, built by ``tools/perf_sentry.py`` from
+  every committed ``BENCH_r*`` snapshot — per-stat noise bands replace the
+  old hand-pinned r08 constants; the r08 snapshot + flat 25% budget remains
+  the fallback on a checkout without the ledger). The CI slice runs ~10x
+  fewer rows than the snapshot's run, so this is a smoke gate for gross
+  regressions — a structural slowdown in the data plane, not a ±10% noise
+  detector;
+- the interactive-burst p50 (``burst_p50_ms``) regresses beyond its ledger
+  baseline + band — the millisecond-control-plane gate (plan cache +
+  run_plan dispatch + head bypass + doorbell all sit under this number);
 - the burst's repeated-query slice shows NO plan-cache hits (hit-rate must
   be > 0: identical query shapes re-executed must not replan);
 - an indexed shuffle writes more blocks than map tasks (the M-not-M×R
@@ -59,7 +63,15 @@ when:
 - the Prometheus scrape-endpoint liveness check failed: one real scrape of
   the head's ``obs.scrape_port`` endpoint must parse in the exposition
   format, carry at least one ``tenant``-labeled series, and at least one
-  ``serve_`` series (docs/observability.md "Scrape endpoint").
+  ``serve_`` series (docs/observability.md "Scrape endpoint");
+- step-profiler overhead exceeds 5% on the fit step p50
+  (``fit_profile_probe``: interleaved medians of profiler-on vs -off fits,
+  +0.25 ms quantization floor — the always-on step-phase decomposition
+  must stay ~free on the train loop);
+- the live-MFU parity check failed: the estimator's live FLOPs accounting
+  (XLA cost analysis, the ``estimator.mfu`` gauge) and the cost-model's
+  analytic FLOPs for the same model must agree within the probe's
+  tolerance (docs/observability.md "Compute observatory").
 
 Usage: ``python tools/perf_smoke.py [artifact.json]``
 """
@@ -73,14 +85,31 @@ import re
 import subprocess
 import sys
 
-REGRESSION_BUDGET = 0.25  # fail above snapshot * (1 + budget)
+REGRESSION_BUDGET = 0.25  # fallback budget when the sentry ledger is absent
 CONSUMER_IDLE_BUDGET_S = 0.2  # absolute: the streaming consumer stays fed
 OBS_OVERHEAD_BUDGET = 0.05  # telemetry-on vs -off on the warm-query p50
+PROFILER_OVERHEAD_BUDGET = 0.05  # step-profiler-on vs -off on the fit step p50
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
+# legacy fallback snapshot — thresholds normally come from the sentry's
+# committed BENCH_BASELINE.json (tools/perf_sentry.py); this keeps the
+# tool runnable on a checkout without the ledger
 SNAPSHOT = "BENCH_r08.json"
+
+
+def _sentry_baseline() -> dict:
+    """The committed sentry ledger's baseline section ({} when absent or
+    invalid — callers fall back to the r08 snapshot constants)."""
+    if REPO not in sys.path:
+        sys.path.insert(0, REPO)
+    try:
+        from tools.perf_sentry import load_baseline
+
+        return load_baseline() or {}
+    except Exception:
+        return {}
 
 
 def _snapshot_value(key: str) -> float | None:
@@ -123,8 +152,23 @@ def main() -> int:
     artifact_path = sys.argv[1] if len(sys.argv) > 1 else "perf_smoke.json"
     result = run_bench()
     detail = result["detail"]
-    reference = snapshot_etl_query_s()
+    baseline = _sentry_baseline()
+
+    def ref_of(stat: str, legacy_key: str):
+        """(reference value, regression budget) for one gated stat: the
+        sentry ledger's baseline + noise band when committed, else the
+        legacy r08-snapshot value + the flat 25% budget."""
+        entry = baseline.get(stat)
+        if entry and entry.get("value"):
+            return float(entry["value"]), float(entry["band"])
+        return _snapshot_value(legacy_key), REGRESSION_BUDGET
+
+    reference, etl_budget = ref_of("etl_query_s", "etl_query_s")
+    burst_ref, burst_budget = ref_of("burst_p50_ms", "burst_p50_ms")
     artifact = {
+        "thresholds_source": (
+            "sentry-ledger" if baseline else "r08-snapshot"
+        ),
         "etl_query_s": detail["etl_query_s"],
         "burst_p50_ms": detail.get("burst_p50_ms"),
         "burst_p99_ms": detail.get("burst_p99_ms"),
@@ -146,8 +190,9 @@ def main() -> int:
         "recovery_overhead": detail.get("recovery_overhead"),
         "etl_breakdown": detail.get("etl_breakdown", {}),
         "shuffle_probe": detail.get("shuffle_probe", {}),
+        "fit_profile_probe": detail.get("fit_profile_probe", {}),
         "reference_etl_query_s": reference,
-        "reference_burst_p50_ms": _snapshot_value("burst_p50_ms"),
+        "reference_burst_p50_ms": burst_ref,
         "reference_streaming_vs_scan": _snapshot_value("streaming_vs_scan"),
         "reference_streaming_hybrid_vs_scan": _snapshot_value(
             "streaming_hybrid_vs_scan"
@@ -161,21 +206,21 @@ def main() -> int:
 
     failures = []
     if reference is not None:
-        limit = reference * (1.0 + REGRESSION_BUDGET)
+        limit = reference * (1.0 + etl_budget)
         if detail["etl_query_s"] > limit:
             failures.append(
                 f"etl_query_s {detail['etl_query_s']:.3f}s exceeds "
-                f"{limit:.3f}s (snapshot {reference:.3f}s + "
-                f"{REGRESSION_BUDGET:.0%})"
+                f"{limit:.3f}s ({artifact['thresholds_source']} "
+                f"{reference:.3f}s + {etl_budget:.0%})"
             )
-    burst_ref = artifact["reference_burst_p50_ms"]
     burst_p50 = artifact["burst_p50_ms"]
     if burst_ref is not None and burst_p50 is not None:
-        limit = burst_ref * (1.0 + REGRESSION_BUDGET)
+        limit = burst_ref * (1.0 + burst_budget)
         if burst_p50 > limit:
             failures.append(
                 f"burst_p50_ms {burst_p50:.2f} exceeds {limit:.2f} "
-                f"(snapshot {burst_ref:.2f} + {REGRESSION_BUDGET:.0%})"
+                f"({artifact['thresholds_source']} {burst_ref:.2f} + "
+                f"{burst_budget:.0%})"
             )
     hit_rate = artifact["plan_cache_hit_rate"]
     if hit_rate is not None and hit_rate <= 0.0:
@@ -288,6 +333,29 @@ def main() -> int:
                 )
     else:
         failures.append("obs_overhead_probe missing from bench detail")
+    fit_probe = artifact["fit_profile_probe"]
+    if fit_probe:
+        on_ms = fit_probe.get("step_p50_on_ms")
+        off_ms = fit_probe.get("step_p50_off_ms")
+        if on_ms is None or off_ms is None:
+            failures.append(f"fit profile probe incomplete: {fit_probe}")
+        # same shape as the telemetry gate: ≤5% on the fit step p50 with a
+        # 0.25 ms quantization floor — the ALWAYS-ON step profiler must
+        # stay ~free on the train loop
+        elif on_ms > off_ms * (1.0 + PROFILER_OVERHEAD_BUDGET) + 0.25:
+            failures.append(
+                f"step-profiler-on fit step p50 {on_ms:.3f}ms exceeds "
+                f"profiler-off {off_ms:.3f}ms by more than "
+                f"{PROFILER_OVERHEAD_BUDGET:.0%} (+0.25ms floor)"
+            )
+        if not fit_probe.get("mfu_parity_ok"):
+            failures.append(
+                f"live-MFU vs bench-analytic parity failed: {fit_probe} "
+                "(the estimator's XLA-cost-analysis FLOPs and the "
+                "costmodel's analytic FLOPs must describe the same step)"
+            )
+    else:
+        failures.append("fit_profile_probe missing from bench detail")
     for entry in artifact["shuffle_probe"].get("shuffle", []):
         if entry.get("indexed") and entry["blocks"] > entry["map_tasks"]:
             failures.append(

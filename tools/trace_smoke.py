@@ -81,7 +81,14 @@ def main() -> None:
     time.sleep(0.2)
     dep.close()
 
-    path = sys.argv[1] if len(sys.argv) > 1 else "trace_smoke.json"
+    if len(sys.argv) > 1:
+        path = sys.argv[1]
+    else:
+        # tool traces land in the gitignored artifacts/ dir, not the repo
+        # root (obs/profiler.py artifacts_dir)
+        from raydp_tpu.obs.profiler import artifacts_dir
+
+        path = os.path.join(artifacts_dir(), "trace_smoke.json")
     raydp_tpu.export_trace(path)
     with open(path) as f:
         doc = json.load(f)
