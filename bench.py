@@ -476,6 +476,220 @@ def serving_probe() -> dict:
                 pass
 
 
+def _decode_kernel_parity() -> dict:
+    """In-process kernel-family parity evidence for the decode bench: the
+    two bitwise contracts the serving numbers rest on, re-proved on the
+    box that produced them (the same checks tests/test_flash_decode.py
+    gates, one shape each — evidence in the snapshot, not just in CI).
+
+    - one-pass deferred-rescale body ≡ reference body, bit-for-bit;
+    - flash_decode over a kv_len-row cache ≡ row kv_len-1 of a causal
+      prefill at the full fixed cache shape, bit-for-bit (the failover
+      re-prefill contract)."""
+    import jax.numpy as jnp
+
+    from raydp_tpu.ops.flash_attention import (
+        _flash_call, flash_attention, flash_decode,
+    )
+
+    b, h, tcap, d = 1, 2, 128, 32
+    kv_len = 37
+    rng = np.random.default_rng(23)
+    q = jnp.asarray(rng.standard_normal((b, h, tcap, d)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((b, h, tcap, d)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((b, h, tcap, d)), jnp.float32)
+
+    onepass_out = {}
+    for onepass in (False, True):
+        o, m, l = _flash_call(  # noqa: E741
+            q, k, v, 0, 0, True, None, None, None,
+            normalize=True, onepass=onepass,
+        )
+        onepass_out[onepass] = (np.asarray(o), np.asarray(m), np.asarray(l))
+    onepass_ok = all(
+        np.array_equal(a, b_)
+        for a, b_ in zip(onepass_out[False], onepass_out[True])
+    )
+
+    ref = flash_attention(q, k, v, True)
+    got = flash_decode(
+        q[:, :, kv_len - 1: kv_len], k, v,
+        jnp.full((b,), kv_len, jnp.int32),
+    )
+    decode_ok = np.array_equal(
+        np.asarray(got), np.asarray(ref[:, :, kv_len - 1: kv_len])
+    )
+    return {
+        "onepass_bit_identical": bool(onepass_ok),
+        "decode_vs_prefill_bit_identical": bool(decode_ok),
+        "ok": bool(onepass_ok and decode_ok),
+    }
+
+
+def decode_serving_probe() -> dict:
+    """Streaming decode load generator (docs/serving.md "Decode serving").
+
+    A tiny TransformerLM checkpoint is published through the estimator
+    checkpoint channel and deployed on two decode-enabled replicas; N
+    closed-loop clients each drive ``dep.stream`` back to back for a fixed
+    wall-clock window, timestamping every token. Reports sustained
+    ``decode_tokens_per_sec`` across the whole pool, TTFT (first token of
+    each stream, the prefill + queue cost), and the per-token p99 over
+    inter-token gaps under multi-client load — gated against a fixed SLO
+    (``BENCH_DECODE_TOKEN_SLO_MS``, default 1000ms: generous on a 2-core
+    CPU box running the pallas interpreter; the gate catches structural
+    regressions — a compile inside the decode loop, a stalled scheduler —
+    not kernel speed, which MFU tracks on real chips).
+
+    ``kernel_parity`` re-proves the bitwise kernel contracts in-process so
+    every committed snapshot carries the parity evidence next to the
+    throughput numbers it justifies."""
+    import tempfile
+    import threading
+
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu import serve
+    from raydp_tpu.estimator import JaxEstimator
+    from raydp_tpu.models import TransformerLM
+
+    slo_ms = float(os.environ.get("BENCH_DECODE_TOKEN_SLO_MS", 1000.0))
+    duration_s = float(os.environ.get("BENCH_DECODE_SECONDS", 4.0))
+    n_clients = int(os.environ.get("BENCH_DECODE_CLIENTS", 3))
+    max_new = int(os.environ.get("BENCH_DECODE_MAX_NEW", 16))
+
+    parity = _decode_kernel_parity()
+
+    vocab = 64
+    model = TransformerLM(
+        vocab_size=vocab, d_model=32, num_heads=2, num_layers=2,
+        max_len=256, attn_impl="flash", dtype=jnp.float32,
+    )
+    ckpt_dir = tempfile.mkdtemp(prefix="bench-decode-ckpt-")
+    est = JaxEstimator(model=model, checkpoint_dir=ckpt_dir)
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    est._save_checkpoint(params, 0, {})
+
+    dep = None
+    try:
+        dep = serve.deploy(
+            model=model, checkpoint_dir=ckpt_dir, replicas=2,
+            conf={
+                "serve.decode.enabled": True,
+                "serve.decode.capacity_tokens": 128,
+                "serve.decode.page_tokens": 32,
+                "serve.decode.max_seqs": 4,
+                "serve.decode.max_new_tokens": max_new,
+            },
+        )
+
+        rng = np.random.default_rng(17)
+        prompts = [
+            [int(t) for t in rng.integers(0, vocab, rng.integers(3, 12))]
+            for _ in range(32)
+        ]
+
+        # warm BOTH replicas' decode engines (stream round-robins, so two
+        # back-to-back streams hit both): the prefill + decode-step jit
+        # compiles land outside the measured window, the same warm-path
+        # discipline as every other probe — the gate is about the decode
+        # loop's structure, not first-call XLA cost
+        for _ in range(2):
+            dep.generate(prompts[0], 2, timeout=300)
+
+        ttfts: list = []
+        gaps: list = []
+        token_count = [0]
+        stream_count = [0]
+        errors: list = []
+        lock = threading.Lock()
+        stop_at = time.perf_counter() + duration_s
+
+        def client(seed: int):
+            local_ttft, local_gaps, tokens, streams = [], [], 0, 0
+            i = seed
+            while time.perf_counter() < stop_at:
+                t_prev = time.perf_counter()
+                first = True
+                try:
+                    for _tok in dep.stream(
+                        prompts[i % len(prompts)], max_new, timeout=120
+                    ):
+                        now = time.perf_counter()
+                        if first:
+                            local_ttft.append(now - t_prev)
+                            first = False
+                        else:
+                            local_gaps.append(now - t_prev)
+                        t_prev = now
+                        tokens += 1
+                    streams += 1
+                except Exception as exc:  # raydp-lint: disable=swallowed-exceptions (closed-loop driver: failures surface in the errors list the gate checks)
+                    with lock:
+                        errors.append(repr(exc)[:200])
+                    break
+                i += 1
+            with lock:
+                ttfts.extend(local_ttft)
+                gaps.extend(local_gaps)
+                token_count[0] += tokens
+                stream_count[0] += streams
+
+        threads = [
+            threading.Thread(target=client, args=(k * 7,))
+            for k in range(n_clients)
+        ]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        elapsed = time.perf_counter() - t0
+
+        gaps.sort()
+        ttfts.sort()
+        n_gaps = len(gaps)
+        token_p99_ms = (
+            gaps[min(n_gaps - 1, int(n_gaps * 0.99))] * 1000
+            if n_gaps else None
+        )
+        ttft_ms = ttfts[len(ttfts) // 2] * 1000 if ttfts else None
+        tokens = token_count[0]
+        tps = tokens / elapsed if elapsed else None
+        return {
+            "clients": n_clients,
+            "streams": stream_count[0],
+            "tokens": tokens,
+            "decode_tokens_per_sec": round(tps, 1) if tps else None,
+            "ttft_ms": round(ttft_ms, 2) if ttft_ms is not None else None,
+            "token_p99_ms": (
+                round(token_p99_ms, 2) if token_p99_ms is not None else None
+            ),
+            "token_slo_ms": slo_ms,
+            "kernel_parity": parity,
+            "errors": errors[:3],
+            "ok": bool(
+                parity["ok"]
+                and tokens > 0
+                and not errors
+                and token_p99_ms is not None
+                and token_p99_ms <= slo_ms
+            ),
+        }
+    except Exception as exc:  # the bench must report, not crash
+        return {"ok": False, "kernel_parity": parity,
+                "error": repr(exc)[:300]}
+    finally:
+        if dep is not None:
+            try:
+                dep.close()
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (probe teardown best-effort)
+                pass
+
+
 def interactive_burst(session, df, n_queries: int) -> dict:
     """p50/p99 latency of ``n_queries`` repeated identical-shape queries on
     a live session — the interactive workload of ROADMAP item 1. One warm-up
@@ -1715,6 +1929,12 @@ def main():
     # training clocks (its wall time touches no other metric)
     serving = serving_probe()
 
+    # decode-native serving probe (docs/serving.md "Decode serving"):
+    # multi-client streaming load → decode tokens/sec, TTFT, per-token
+    # p99, plus in-process kernel-parity evidence — same placement as the
+    # request/response serving probe, after all training clocks
+    decode_serving = decode_serving_probe()
+
     # multi-tenant probe (raydp_tpu.tenancy): interactive burst p50/p99
     # solo vs under a co-tenant's heavy shuffle, plus cross-tenant
     # plan-cache evidence — self-contained sessions on the same cluster,
@@ -1767,6 +1987,7 @@ def main():
             **cmp,
             "obs_metrics": obs_headline,
             "serving_probe": serving,
+            "decode_serving_probe": decode_serving,
             "tenant_isolation_probe": tenant_probe,
             "obs_overhead_probe": obs_probe,
             "fit_profile_probe": fit_probe,

@@ -58,6 +58,63 @@ def _attend(q, k, v, *, impl: str, axis: str, causal: bool):
     raise ValueError(f"unknown attention impl {impl!r}")
 
 
+def _scatter_rows(cache, new, starts):
+    """Insert ``new`` [B, H, t, D] into ``cache`` [B, H, T, D] at per-batch
+    position ``starts`` [B] along the sequence dim (vmapped dynamic update —
+    each sequence in a decode batch sits at its own length)."""
+    def one(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, s, 0))
+
+    return jax.vmap(one)(cache, new, starts)
+
+
+def _scatter_scales(cache, new, starts):
+    """Same as ``_scatter_rows`` for [B, H, T] per-row scale planes."""
+    def one(c, n, s):
+        return jax.lax.dynamic_update_slice(c, n.astype(c.dtype), (0, s))
+
+    return jax.vmap(one)(cache, new, starts)
+
+
+def _decode_attend(q, k_new, v_new, decode_kv, kv_len):
+    """Incremental-decode attention: the new rows' K/V join the cached
+    sequence in-graph (per-batch scatter at each sequence's length), then
+    ``ops.flash_decode`` attends the last ``t`` positions against the whole
+    cache with per-sequence valid-length masking. ``decode_kv`` is either
+    (k, v) dense f32 caches [B, H, Tcap, D] — the bit-exact mode the
+    decode-vs-prefill determinism contract is stated for — or
+    (k_int8, k_scale, v_int8, v_scale) with on-the-fly dequant in-kernel."""
+    from raydp_tpu.ops.flash_attention import flash_decode
+
+    t = q.shape[2]
+    starts = kv_len - t
+    if len(decode_kv) == 2:
+        k_cache, v_cache = decode_kv
+        k_full = _scatter_rows(k_cache, k_new, starts)
+        v_full = _scatter_rows(v_cache, v_new, starts)
+        return flash_decode(q, k_full, v_full, kv_len)
+
+    from raydp_tpu.ops.quantization import quantize_int8
+
+    k8, k_sc, v8, v_sc = decode_kv
+    b, h, tn, d = k_new.shape
+
+    def quant(x):
+        vals, scales = quantize_int8(x.astype(jnp.float32).reshape(b * h * tn, d))
+        return vals.reshape(b, h, tn, d), scales.reshape(b, h, tn)
+
+    kq, kqs = quant(k_new)
+    vq, vqs = quant(v_new)
+    return flash_decode(
+        q,
+        _scatter_rows(k8, kq, starts),
+        _scatter_rows(v8, vq, starts),
+        kv_len,
+        k_scale=_scatter_scales(k_sc, kqs, starts),
+        v_scale=_scatter_scales(v_sc, vqs, starts),
+    )
+
+
 class Block(nn.Module):
     num_heads: int
     attn_impl: str = "full"
@@ -70,7 +127,7 @@ class Block(nn.Module):
     quantized_mlp: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, *, decode_kv=None, kv_len=None, return_kv=False):
         d_model = x.shape[-1]
         head_dim = d_model // self.num_heads
         y = nn.LayerNorm(dtype=self.dtype)(x)
@@ -81,10 +138,14 @@ class Block(nn.Module):
             b, t, _ = z.shape
             return z.reshape(b, t, self.num_heads, head_dim).transpose(0, 2, 1, 3)
 
-        o = _attend(
-            heads(q), heads(k), heads(v),
-            impl=self.attn_impl, axis=self.seq_axis, causal=True,
-        )
+        q_h, k_h, v_h = heads(q), heads(k), heads(v)
+        if decode_kv is not None:
+            o = _decode_attend(q_h, k_h, v_h, decode_kv, kv_len)
+        else:
+            o = _attend(
+                q_h, k_h, v_h,
+                impl=self.attn_impl, axis=self.seq_axis, causal=True,
+            )
         b, h, t, hd = o.shape
         o = o.transpose(0, 2, 1, 3).reshape(b, t, h * hd)
         x = x + nn.Dense(d_model, dtype=self.dtype, name="proj")(o)
@@ -100,7 +161,12 @@ class Block(nn.Module):
         y = nn.Dense(4 * d_model, dtype=self.dtype, **mlp_kw)(y)
         y = nn.gelu(y)
         y = nn.Dense(d_model, dtype=self.dtype, **mlp_kw)(y)
-        return x + y
+        out = x + y
+        if decode_kv is not None or return_kv:
+            # the new rows' K/V in head layout — the decode engine appends
+            # them to its paged cache after the step
+            return out, (k_h, v_h)
+        return out
 
 
 class TransformerLM(nn.Module):
@@ -116,9 +182,23 @@ class TransformerLM(nn.Module):
     quantized_mlp: bool = False  # int8-MXU forward MLP matmuls (see Block)
 
     @nn.compact
-    def __call__(self, tokens, seq_offset=0):  # tokens [B, T_local] int32
+    def __call__(
+        self, tokens, seq_offset=0, *, kv_caches=None, kv_len=None,
+        return_kv=False,
+    ):  # tokens [B, T_local] int32
         """``seq_offset`` is this shard's global position offset (0 when the
-        full sequence is local; axis_index * T_local under shard_map)."""
+        full sequence is local; axis_index * T_local under shard_map).
+
+        Incremental decode (``kv_caches``/``kv_len``): ``tokens`` holds each
+        sequence's newest ``t`` tokens, ``kv_len`` [B] int32 their total
+        lengths INCLUDING those tokens, and ``kv_caches`` one per-layer dense
+        cache tuple (see ``_decode_attend``). Positions come from ``kv_len``
+        per sequence, overriding ``seq_offset``. Returns (logits, new_kv)
+        where ``new_kv`` is a per-layer list of the new rows' (k, v) in
+        [B, H, t, Dh] layout for the caller's paged cache. ``return_kv``
+        gives the same (logits, new_kv) from a prefill pass — the cache-warm
+        path."""
+        decode = kv_caches is not None
         x = nn.Embed(self.vocab_size, self.d_model, dtype=self.dtype)(tokens)
         pos = self.param(
             "pos_embed",
@@ -127,21 +207,39 @@ class TransformerLM(nn.Module):
             jnp.float32,
         )
         t = tokens.shape[1]
-        pos_slice = jax.lax.dynamic_slice_in_dim(pos, seq_offset, t, axis=0)
+        if decode:
+            starts = jnp.asarray(kv_len, jnp.int32) - t
+            pos_slice = jax.vmap(
+                lambda s: jax.lax.dynamic_slice_in_dim(pos, s, t, axis=0)
+            )(starts)  # [B, t, d_model]
+        else:
+            pos_slice = jax.lax.dynamic_slice_in_dim(pos, seq_offset, t, axis=0)
         x = x + pos_slice.astype(self.dtype)
         block_cls = Block
         if self.remat:
             block_cls = nn.remat(Block)
-        for _ in range(self.num_layers):
-            x = block_cls(
+        new_kv = []
+        for layer in range(self.num_layers):
+            block = block_cls(
                 num_heads=self.num_heads,
                 attn_impl=self.attn_impl,
                 seq_axis=self.seq_axis,
                 dtype=self.dtype,
                 quantized_mlp=self.quantized_mlp,
-            )(x)
+            )
+            if decode:
+                x, kv = block(x, decode_kv=kv_caches[layer], kv_len=kv_len)
+                new_kv.append(kv)
+            elif return_kv:
+                x, kv = block(x, return_kv=True)
+                new_kv.append(kv)
+            else:
+                x = block(x)
         x = nn.LayerNorm(dtype=self.dtype)(x)
-        return nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
+        logits = nn.Dense(self.vocab_size, dtype=jnp.float32, name="lm_head")(x)
+        if decode or return_kv:
+            return logits, new_kv
+        return logits
 
 
 def sequence_parallel_apply(model: TransformerLM, params, tokens, mesh):

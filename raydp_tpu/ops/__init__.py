@@ -4,7 +4,7 @@ from raydp_tpu.ops.embedding import (
     embedding_lookup_vocab_sharded,
     sharded_embedding_lookup,
 )
-from raydp_tpu.ops.flash_attention import flash_attention
+from raydp_tpu.ops.flash_attention import flash_attention, flash_decode
 from raydp_tpu.ops.interaction import dot_interaction, dot_interaction_pallas
 from raydp_tpu.ops.quantization import (
     dequantize_int8,
@@ -17,6 +17,7 @@ __all__ = [
     "dot_interaction",
     "dot_interaction_pallas",
     "flash_attention",
+    "flash_decode",
     "int8_matmul",
     "quantize_int8",
     "embedding_lookup_vocab_sharded",

@@ -9,7 +9,7 @@ MXU (`preferred_element_type=f32`); masking and the softmax update run on the
 VPU. Causal masking uses global positions (runtime offsets from SMEM), and
 k-blocks entirely in the future are skipped outright (~2x causal throughput).
 
-One kernel serves two surfaces:
+One kernel family serves three surfaces:
 - ``flash_attention``: normalized output, offsets 0 — the single-device /
   per-shard attention op. Its custom VJP is a blockwise FlashAttention-2
   backward (two pallas kernels over the saved output + logsumexp), so
@@ -17,19 +17,42 @@ One kernel serves two surfaces:
 - ``flash_attention_stats``: UNNORMALIZED output + (m, l) stats with caller
   offsets — the per-ring-step block product `parallel.ring_attention`
   merges across devices (``use_flash=True``).
+- ``flash_decode``: incremental-decode attention of a few new query rows
+  against a KV cache with per-sequence valid lengths (SMEM), sharing the
+  same online-softmax update — so decode-vs-prefill is bit-identical at a
+  fixed shape. Optional int8 K/V with on-the-fly per-row dequant.
 
-Off-TPU the same kernel runs in interpret mode, so CPU-mesh tests exercise
+Two forward kernel bodies implement the same math: ``_flash_kernel`` (the
+r05 two-term update — reference) and ``_flash_kernel_onepass`` (default),
+which folds the per-block rescale of the [BQ, D] accumulator out of the VPU
+hot loop by predicating it on the running max actually moving. When the max
+is stable (the common case once a few blocks have been seen) the rescale is
+skipped outright; when it fires, the skipped-row multiplies are ×exp(0)=1,
+so the two kernels are bit-identical by construction — the parity gate in
+the bench is exact equality, not allclose.
+
+Off-TPU the same kernels run in interpret mode, so CPU-mesh tests exercise
 the identical code path.
 """
 
 from __future__ import annotations
 
 import functools
+import os
 
 import jax
 import jax.numpy as jnp
 
 NEG_INF = -1e30
+
+
+def use_onepass_default() -> bool:
+    """Whether the one-pass (deferred-rescale) forward kernel is the default.
+    Env escape hatch ``RAYDP_TPU_FLASH_ONEPASS=0`` pins the reference kernel
+    (bisecting a numerics report; the two are bit-identical by design)."""
+    return os.environ.get("RAYDP_TPU_FLASH_ONEPASS", "1").lower() not in (
+        "0", "false", "off"
+    )
 
 
 def _causal_block_live(q_off_ref, k_off_ref, qi, ki, block_q, block_k, causal):
@@ -120,6 +143,93 @@ def _flash_kernel(
         l_ref[0] = l_acc[:, :1]
 
 
+def _flash_kernel_onepass(
+    q_off_ref, k_off_ref, q_ref, k_ref, v_ref, o_ref, m_ref, l_ref,
+    o_acc, m_acc, l_acc, *, scale, causal, block_q, block_k, normalize,
+):
+    """One-pass online softmax with the accumulator rescale deferred.
+
+    The r05 roofline blames the per-block ``alpha * o_acc`` rescale — a
+    [BQ, D] VPU multiply every k iteration — for the LM attention VPU wall.
+    Here the rescale (of both o and l) only runs when the running max
+    actually moved (``any(block_max > m_prev)``); otherwise alpha == exp(0)
+    == 1 exactly and the multiply is dead weight. Normalization stays
+    deferred to the finalize step, so the hot loop is: one MXU score dot,
+    one exp, one MXU p·v dot, one add. Bit-identical to ``_flash_kernel``
+    (the gated multiplies are exactly ×1.0 when skipped)."""
+    from jax.experimental import pallas as pl
+
+    qi = pl.program_id(1)
+    ki = pl.program_id(2)
+    num_k = pl.num_programs(2)
+
+    @pl.when(ki == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, NEG_INF)
+        l_acc[:] = jnp.zeros_like(l_acc)
+
+    block_live = _causal_block_live(
+        q_off_ref, k_off_ref, qi, ki, block_q, block_k, causal
+    )
+
+    @pl.when(block_live)
+    def _accumulate():
+        q = q_ref[0]  # [BQ, D]
+        k = k_ref[0]  # [BK, D]
+        v = v_ref[0]  # [BK, D]
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [BQ, BK]
+
+        if causal:
+            scores = _causal_mask(
+                scores, q_off_ref, k_off_ref, qi, ki, block_q, block_k
+            )
+
+        m_prev = m_acc[:, :1]  # [BQ, 1]
+        l_prev = l_acc[:, :1]
+        block_max = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, block_max)
+        p = jnp.exp(scores - m_new)
+        if causal:
+            p = jnp.where(scores > NEG_INF / 2, p, 0.0)
+
+        p_sum = jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        moved = jnp.any(block_max > m_prev)
+
+        # the rescale branch keeps the reference kernel's exact expression
+        # shape (alpha·acc + new in one statement) so XLA's fusion decisions
+        # — FMA contraction in particular — can't introduce 1-ulp drift; the
+        # skip branch drops the ×1.0 multiplies outright (exact identity)
+        @pl.when(moved)
+        def _rescale():
+            alpha = jnp.exp(m_prev - m_new)
+            l_acc[:] = jnp.broadcast_to(alpha * l_prev + p_sum, l_acc.shape)
+            o_acc[:] = alpha * o_acc[:] + pv
+
+        @pl.when(jnp.logical_not(moved))
+        def _no_rescale():
+            l_acc[:] = jnp.broadcast_to(l_prev + p_sum, l_acc.shape)
+            o_acc[:] = o_acc[:] + pv
+
+        m_acc[:] = jnp.broadcast_to(m_new, m_acc.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        if normalize:
+            o_ref[0] = (
+                o_acc[:] / jnp.maximum(l_acc[:, :1], 1e-30)
+            ).astype(o_ref.dtype)
+        else:
+            o_ref[0] = o_acc[:].astype(o_ref.dtype)
+        m_ref[0] = m_acc[:, :1]
+        l_ref[0] = l_acc[:, :1]
+
+
 def _union_vma(*arrays):
     # jax.typeof (and the vma tracking it exposes) only exists on modern jax;
     # on older releases (0.4.x) there is no varying-manual-axes machinery to
@@ -146,16 +256,19 @@ def _pvary_scalar(x, axis_name):
 
 
 def _flash_call(
-    q, k, v, q_offset, k_offset, causal, block_q, block_k, interpret, normalize
+    q, k, v, q_offset, k_offset, causal, block_q, block_k, interpret,
+    normalize, onepass=None,
 ):
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     if interpret is None:
         interpret = jax.default_backend() != "tpu"
+    if onepass is None:
+        onepass = use_onepass_default()
     b, h, t, d = q.shape
     tk = k.shape[2]
-    auto_q, auto_k = pick_blocks(t, tk)
+    auto_q, auto_k = pick_blocks(t, tk, head_dim=d)
     block_q = min(block_q or auto_q, t)
     block_k = min(block_k or auto_k, tk)
     if t % block_q or tk % block_k:
@@ -168,7 +281,7 @@ def _flash_call(
     vf = v.reshape(bh, tk, d)
 
     kernel = functools.partial(
-        _flash_kernel,
+        _flash_kernel_onepass if onepass else _flash_kernel,
         scale=d**-0.5, causal=causal, block_q=block_q, block_k=block_k,
         normalize=normalize,
     )
@@ -471,14 +584,24 @@ def flash_backward_blocks(
     )
 
 
-def pick_blocks(t_q: int, t_k: int) -> tuple:
+def pick_blocks(t_q: int, t_k: int, head_dim: int | None = None) -> tuple:
     """Largest power-of-two blocks (≤1024 each) dividing the sequence
     lengths. Measured on TPU v5e at T=8k/head_dim 128: 1024×1024 runs the
     fwd+bwd pair ~1.4x faster than the old 512×1024 caps (26.5→18.4ms per
     layer — the BACKWARD kernel wants the larger q tile) with forward a
     touch faster too, and still beats both the einsum reference and jax's
     bundled flash kernel; 2048 tiles fail to compile (VMEM). Tiny sequences
-    just clamp to themselves."""
+    just clamp to themselves.
+
+    ``head_dim`` tunes the cap to the lane width: the 1024 cap was measured
+    at D=128 (one lane-width), and the VMEM footprint of a tile scales with
+    block·D — so past 128 the cap halves per doubling of D, keeping the
+    tile footprint (and the compile success envelope) constant."""
+
+    cap = 1024
+    if head_dim is not None:
+        while cap > 128 and cap * head_dim > 1024 * 128:
+            cap //= 2
 
     def _block(t, cap):
         b = cap
@@ -486,7 +609,7 @@ def pick_blocks(t_q: int, t_k: int) -> tuple:
             b //= 2
         return b
 
-    return _block(t_q, 1024), _block(t_k, 1024)
+    return _block(t_q, cap), _block(t_k, cap)
 
 
 def _reference(q, k, v, causal):
@@ -526,3 +649,198 @@ def _bwd(causal, block_q, block_k, interpret, residuals, g):
 
 
 flash_attention.defvjp(_fwd, _bwd)
+
+
+# ---------------------------------------------------------------------------
+# decode: a few new query rows against a KV cache. Same online-softmax
+# update as the prefill kernel (deferred rescale + deferred normalization),
+# same masking predicate (keep k_pos <= q_pos), same NEG_INF/p-zeroing
+# semantics — so a decode step at a fixed shape is bit-identical to the
+# matching rows of a prefill pass over the same (dequantized) cache when
+# block_k agrees. Grid is (batch·head, k-block) with per-sequence valid
+# lengths in SMEM; k-blocks entirely past a sequence's length are skipped.
+# ---------------------------------------------------------------------------
+
+
+def _decode_body(
+    kv_len_ref, q_ref, load_kv, o_ref, o_acc, m_acc, l_acc,
+    *, scale, block_k, heads, tq,
+):
+    """Shared decode kernel body. ``load_kv()`` materializes this k-block's
+    [BK, D] f32 K and V (identity for f32/bf16 caches, per-row dequant for
+    int8) — kept behind a thunk so dead blocks skip the dequant too."""
+    from jax.experimental import pallas as pl
+
+    bh = pl.program_id(0)
+    ki = pl.program_id(1)
+    num_k = pl.num_programs(1)
+    kv_len = kv_len_ref[bh // heads]
+
+    @pl.when(ki == 0)
+    def _init():
+        o_acc[:] = jnp.zeros_like(o_acc)
+        m_acc[:] = jnp.full_like(m_acc, NEG_INF)
+        l_acc[:] = jnp.zeros_like(l_acc)
+
+    @pl.when(ki * block_k < kv_len)
+    def _accumulate():
+        q = q_ref[0]  # [TQ, D] — last TQ positions of the sequence
+        k, v = load_kv()  # [BK, D] f32 each
+        scores = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())), preferred_element_type=jnp.float32
+        ) * scale  # [TQ, BK]
+
+        # global positions: query rows are the last TQ positions (front
+        # padding, if any, lands on negative q_pos and masks to nothing)
+        q_pos = kv_len - tq + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, block_k), 0
+        )
+        k_pos = ki * block_k + jax.lax.broadcasted_iota(
+            jnp.int32, (tq, block_k), 1
+        )
+        scores = jnp.where(q_pos >= k_pos, scores, NEG_INF)
+
+        m_prev = m_acc[:, :1]
+        l_prev = l_acc[:, :1]
+        block_max = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, block_max)
+        p = jnp.exp(scores - m_new)
+        p = jnp.where(scores > NEG_INF / 2, p, 0.0)
+
+        p_sum = jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p, v, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32
+        )
+        moved = jnp.any(block_max > m_prev)
+
+        @pl.when(moved)
+        def _rescale():
+            alpha = jnp.exp(m_prev - m_new)
+            l_acc[:] = jnp.broadcast_to(alpha * l_prev + p_sum, l_acc.shape)
+            o_acc[:] = alpha * o_acc[:] + pv
+
+        @pl.when(jnp.logical_not(moved))
+        def _no_rescale():
+            l_acc[:] = jnp.broadcast_to(l_prev + p_sum, l_acc.shape)
+            o_acc[:] = o_acc[:] + pv
+
+        m_acc[:] = jnp.broadcast_to(m_new, m_acc.shape)
+
+    @pl.when(ki == num_k - 1)
+    def _finalize():
+        o_ref[0] = (
+            o_acc[:] / jnp.maximum(l_acc[:, :1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+def _decode_kernel(
+    kv_len_ref, q_ref, k_ref, v_ref, o_ref, o_acc, m_acc, l_acc,
+    *, scale, block_k, heads, tq,
+):
+    def load_kv():
+        return (
+            k_ref[0].astype(jnp.float32),
+            v_ref[0].astype(jnp.float32),
+        )
+
+    _decode_body(
+        kv_len_ref, q_ref, load_kv, o_ref, o_acc, m_acc, l_acc,
+        scale=scale, block_k=block_k, heads=heads, tq=tq,
+    )
+
+
+def _decode_kernel_int8(
+    kv_len_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref, o_ref,
+    o_acc, m_acc, l_acc, *, scale, block_k, heads, tq,
+):
+    def load_kv():
+        # per-row dequant on the fly (rows = cache positions): int8 values
+        # carry a [BK, 1] f32 scale each for K and V — the layout
+        # ops.quantization.quantize_int8 emits
+        return (
+            k_ref[0].astype(jnp.float32) * ks_ref[0],
+            v_ref[0].astype(jnp.float32) * vs_ref[0],
+        )
+
+    _decode_body(
+        kv_len_ref, q_ref, load_kv, o_ref, o_acc, m_acc, l_acc,
+        scale=scale, block_k=block_k, heads=heads, tq=tq,
+    )
+
+
+def flash_decode(
+    q, k, v, kv_len, *, k_scale=None, v_scale=None,
+    block_k: int | None = None, interpret: bool | None = None,
+):
+    """Decode attention: the last ``Tq`` query rows of each sequence attend
+    a KV cache with per-sequence valid lengths.
+
+    q: [B, H, Tq, D] — queries for the newest Tq positions (usually 1).
+    k, v: [B, H, Tk, D] — cache at fixed capacity Tk (f32/bf16; or int8
+        with ``k_scale``/``v_scale`` [B, H, Tk] per-row scales from
+        ``ops.quantization.quantize_int8``).
+    kv_len: [B] int32 — valid lengths INCLUDING the Tq new positions.
+
+    Returns [B, H, Tq, D] normalized attention output. Positions at or past
+    ``kv_len`` are masked; k-blocks entirely past a sequence's length are
+    skipped. Tq is padded up to the 8-sublane tile at the FRONT (pad rows
+    get out-of-range q_pos and are sliced off), so callers can pass Tq=1.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    int8_kv = k_scale is not None
+    if int8_kv != (v_scale is not None):
+        raise ValueError("k_scale and v_scale must be provided together")
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    block_k = min(block_k or pick_blocks(tq, tk, head_dim=d)[1], tk)
+    if tk % block_k:
+        raise ValueError(f"cache capacity {tk} must divide block_k {block_k}")
+
+    tq_pad = max(8, -(-tq // 8) * 8)
+    if tq_pad != tq:
+        q = jnp.concatenate(
+            [jnp.broadcast_to(q[:, :, :1], (b, h, tq_pad - tq, d)), q], axis=2
+        )
+    bh = b * h
+    qf = q.reshape(bh, tq_pad, d)
+    kf = k.reshape(bh, tk, d)
+    vf = v.reshape(bh, tk, d)
+    kv_len_arr = jnp.asarray(kv_len, jnp.int32).reshape(b)
+
+    kernel_kwargs = dict(scale=d**-0.5, block_k=block_k, heads=h, tq=tq_pad)
+    smem = pl.BlockSpec(memory_space=pltpu.SMEM)
+    q_spec = pl.BlockSpec((1, tq_pad, d), lambda b_, j: (b_, 0, 0))
+    kv_spec = pl.BlockSpec((1, block_k, d), lambda b_, j: (b_, j, 0))
+    scale_spec = pl.BlockSpec((1, block_k, 1), lambda b_, j: (b_, j, 0))
+
+    if int8_kv:
+        kernel = functools.partial(_decode_kernel_int8, **kernel_kwargs)
+        in_specs = [smem, q_spec, kv_spec, kv_spec, scale_spec, scale_spec]
+        operands = (
+            kv_len_arr, qf, kf, vf,
+            k_scale.reshape(bh, tk, 1).astype(jnp.float32),
+            v_scale.reshape(bh, tk, 1).astype(jnp.float32),
+        )
+    else:
+        kernel = functools.partial(_decode_kernel, **kernel_kwargs)
+        in_specs = [smem, q_spec, kv_spec, kv_spec]
+        operands = (kv_len_arr, qf, kf, vf)
+
+    o = pl.pallas_call(
+        kernel,
+        out_shape=jax.ShapeDtypeStruct((bh, tq_pad, d), q.dtype),
+        grid=(bh, tk // block_k),
+        in_specs=in_specs,
+        out_specs=q_spec,
+        scratch_shapes=[
+            pltpu.VMEM((tq_pad, d), jnp.float32),
+            pltpu.VMEM((tq_pad, 128), jnp.float32),
+            pltpu.VMEM((tq_pad, 128), jnp.float32),
+        ],
+        interpret=interpret,
+    )(*operands)
+    return o.reshape(b, h, tq_pad, d)[:, :, tq_pad - tq:]

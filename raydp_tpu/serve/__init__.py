@@ -19,6 +19,14 @@ Quick start::
     dep.reload()                              # rolling checkpoint reload
     dep.close()                               # before cluster shutdown
 
+Decode-native serving (``serve.decode.*`` conf keys) adds a second request
+shape: autoregressive token streams. Each replica hosts a continuous-batching
+``DecodeEngine`` (iteration-level scheduling over a paged, shm-backed KV
+cache; ``serve/decode.py``) and the deployment exposes
+``stream(prompt_tokens, max_new)`` / ``generate(...)`` with zero-drop
+failover — a stream whose replica dies is re-prefilled on a survivor and
+continues bit-identically (f32 cache).
+
 See docs/serving.md for the conf table (``serve.*`` keys), the failover
 semantics, and the observability rows.
 """
@@ -27,13 +35,18 @@ from __future__ import annotations
 
 from raydp_tpu.serve.batcher import DynamicBatcher
 from raydp_tpu.serve.config import ServeConf
+from raydp_tpu.serve.decode import DecodeEngine
 from raydp_tpu.serve.deployment import Deployment, deploy
+from raydp_tpu.serve.kvcache import KVCacheFull, PagedKVCache
 from raydp_tpu.serve.replica import ModelReplica, ReplicaSpec
 
 __all__ = [
+    "DecodeEngine",
     "Deployment",
     "DynamicBatcher",
+    "KVCacheFull",
     "ModelReplica",
+    "PagedKVCache",
     "ReplicaSpec",
     "ServeConf",
     "deploy",

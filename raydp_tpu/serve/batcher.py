@@ -87,6 +87,58 @@ class _Request:
         return self.value
 
 
+class _ChunkAssembly:
+    """Reassembles one oversized request served as several bucket-shaped
+    dispatches. Parts resolve independently (possibly on different replicas,
+    possibly after re-admission); the parent resolves once every part has,
+    with the rows concatenated back in order. Any part failing terminally
+    fails the parent — partial results never reach a caller."""
+
+    def __init__(self, parent: _Request):
+        self.parent = parent
+        self.lock = threading.Lock()
+        self.results: List = []
+        self.remaining = 0
+
+    def arm(self, n_parts: int) -> None:
+        self.results = [None] * n_parts
+        self.remaining = n_parts
+
+    def part_resolved(self, index: int, value) -> None:
+        with self.lock:
+            if self.parent.done.is_set():
+                return
+            self.results[index] = value
+            self.remaining -= 1
+            ready = self.remaining == 0
+        if ready:
+            self.parent.resolve(f_concat(self.results))
+
+    def part_failed(self, error: BaseException) -> None:
+        self.parent.fail(error)
+
+
+class _ChunkPart(_Request):
+    """One bucket-sized slice of an oversized request. Behaves exactly like
+    a request on the dispatch/requeue path (it can be re-admitted on replica
+    failure like any other), but resolution routes through the assembly."""
+
+    __slots__ = ("assembly", "index")
+
+    def __init__(self, rows, n: int, assembly: _ChunkAssembly, index: int):
+        super().__init__(rows, n)
+        self.assembly = assembly
+        self.index = index
+
+    def resolve(self, value) -> None:
+        super().resolve(value)
+        self.assembly.part_resolved(self.index, value)
+
+    def fail(self, error: BaseException) -> None:
+        super().fail(error)
+        self.assembly.part_failed(error)
+
+
 class DynamicBatcher:
     def __init__(
         self,
@@ -128,6 +180,7 @@ class DynamicBatcher:
         self._m_rows = m.counter("serve.rows")
         self._m_batches = m.counter("serve.batches")
         self._m_padded = m.counter("serve.padded_rows")
+        self._m_chunked = m.counter("serve.chunked_dispatches")
         self._m_requeued = m.counter("serve.requeued_requests")
         self._m_dropped = m.counter("serve.dropped_requests")
         self._m_errors = m.counter("serve.dispatch_errors")
@@ -406,13 +459,24 @@ class DynamicBatcher:
                 else f_concat([r.rows for r in batch])
             )
             n = sum(r.n for r in batch)
+            chunk_to = None
+            padded = None
             if conf.dynamic_batching:
-                # resolve()d ladders always contain max_batch_size; a
-                # hand-built ServeConf may not — fall back to no padding
-                bucket = next((b for b in conf.buckets if b >= n), n)
-                padded = pad_rows(rows, bucket)
-                self._m_padded.inc(bucket - n)
-                self._m_fill.observe(n / bucket)
+                bucket = next((b for b in conf.buckets if b >= n), None)
+                if bucket is None and conf.buckets:
+                    # oversized payload (a hand-built ladder whose largest
+                    # bucket is below max_batch_size): chunk it to the
+                    # largest bucket — a raw shape must never compile into
+                    # a live replica's bucket-keyed cache (the same hazard
+                    # replica.profile() truncates against)
+                    chunk_to = max(conf.buckets)
+                else:
+                    # a resolve()d ladder always contains max_batch_size;
+                    # an empty hand-built one falls back to no padding
+                    bucket = bucket if bucket is not None else n
+                    padded = pad_rows(rows, bucket)
+                    self._m_padded.inc(bucket - n)
+                    self._m_fill.observe(n / bucket)
             else:
                 padded = rows
                 self._m_fill.observe(1.0)
@@ -440,10 +504,65 @@ class DynamicBatcher:
                     req.fail(exc)
                 return
         try:
-            self._dispatch_to_replica(batch, n, padded)
+            if chunk_to is not None:
+                self._dispatch_chunked(batch, chunk_to)
+            else:
+                self._dispatch_to_replica(batch, n, padded)
         finally:
             if self._admission is not None:
                 self._admission.release(ticket)
+
+    def _dispatch_chunked(self, batch: List[_Request], largest: int) -> None:
+        """Serve an over-bucket formation as a series of bucket-shaped
+        dispatches: whole requests group greedily up to ``largest``; a
+        single request bigger than ``largest`` splits into parts whose rows
+        reassemble before its caller sees anything. Every dispatched shape
+        is a real bucket shape."""
+        groups: List[List[_Request]] = []
+        current: List[_Request] = []
+        cur_n = 0
+        for req in batch:
+            if req.n > largest:
+                if current:
+                    groups.append(current)
+                    current, cur_n = [], 0
+                assembly = _ChunkAssembly(req)
+                parts = []
+                offset = 0
+                while offset < req.n:
+                    k = min(largest, req.n - offset)
+                    parts.append(_ChunkPart(
+                        f_slice(req.rows, offset, offset + k),
+                        k, assembly, len(parts),
+                    ))
+                    offset += k
+                assembly.arm(len(parts))
+                groups.extend([p] for p in parts)
+            elif cur_n + req.n > largest:
+                groups.append(current)
+                current, cur_n = [req], req.n
+            else:
+                current.append(req)
+                cur_n += req.n
+        if current:
+            groups.append(current)
+        self._m_chunked.inc(len(groups))
+        conf = self._conf
+        t_formed = time.monotonic()
+        for group in groups:
+            g_n = sum(r.n for r in group)
+            rows = (
+                group[0].rows if len(group) == 1
+                else f_concat([r.rows for r in group])
+            )
+            bucket = next((b for b in conf.buckets if b >= g_n), g_n)
+            padded = pad_rows(rows, bucket)
+            for req in group:
+                if req.t_formed is None:
+                    req.t_formed = t_formed
+            self._m_padded.inc(bucket - g_n)
+            self._m_fill.observe(g_n / bucket)
+            self._dispatch_to_replica(group, g_n, padded)
 
     def _dispatch_to_replica(self, batch: List[_Request], n: int, padded) -> None:
         conf = self._conf

@@ -67,6 +67,17 @@ class ServeConf:
     # -- replicas -------------------------------------------------------
     replica_light: bool = True  # zygote warm fork (python -S); see docs
     replica_max_concurrency: int = 4
+    # -- decode serving (docs/serving.md, "Decode serving") -------------
+    # continuous-batching autoregressive decode on each replica: a paged
+    # KV cache in shm plus a fixed-slot decode loop (serve/decode.py).
+    # Opt-in — a deployment that never streams pays nothing for it.
+    decode: bool = False
+    decode_capacity_tokens: int = 512  # per-sequence max prompt+generation
+    decode_page_tokens: int = 128  # KV page granularity
+    decode_max_seqs: int = 4  # concurrent decode slots per replica
+    decode_max_new_tokens: int = 64  # per-request generation cap
+    decode_int8_kv: bool = False  # int8 K/V pages + in-kernel dequant
+    decode_eos_token: Optional[int] = None  # early-stop token id
     # -- request-path tracing (docs/observability.md) -------------------
     # fraction of requests that mint a trace context and emit the sampled
     # serve.request / serve.batch / replica span chain (only when tracing
@@ -125,6 +136,16 @@ class ServeConf:
             slo_p99_ms=(
                 float(get("slo_p99_ms")) if get("slo_p99_ms") is not None
                 else None
+            ),
+            decode=_flag(get("decode.enabled"), False),
+            decode_capacity_tokens=int(get("decode.capacity_tokens", 512)),
+            decode_page_tokens=int(get("decode.page_tokens", 128)),
+            decode_max_seqs=max(1, int(get("decode.max_seqs", 4))),
+            decode_max_new_tokens=int(get("decode.max_new_tokens", 64)),
+            decode_int8_kv=_flag(get("decode.int8_kv"), False),
+            decode_eos_token=(
+                int(get("decode.eos_token"))
+                if get("decode.eos_token") is not None else None
             ),
             replica_light=_flag(get("replica_light"), True),
             replica_max_concurrency=max(

@@ -1,0 +1,425 @@
+"""Continuous-batching decode engine: autoregressive serving for
+``TransformerLM`` checkpoints.
+
+Orca-style iteration-level scheduling: the engine keeps a fixed number of
+decode SLOTS and runs one model step per loop iteration; sequences join a
+slot the moment one frees (after a prefill pass that warms their pages in
+the ``PagedKVCache``) and leave the moment they finish — no bucket-padded
+one-shot batches, no head-of-line blocking behind the longest sequence in
+an admission batch. The decode step always runs at the fixed compiled shape
+``[max_seqs, 1]`` (empty slots carry a pad sequence and are masked by
+``kv_len``), so XLA numerics are bit-stable regardless of which sequences
+share a step — the property the SIGKILL-mid-decode chaos gate's
+token-identity check rests on.
+
+Determinism contract (docs/serving.md): with a float32 cache, a decode step
+is bit-identical to a prefill pass over the same tokens (the kernel-family
+parity in ``ops/flash_attention.py``), so a stream resumed on another
+replica by RE-PREFILLING prompt + already-emitted tokens continues with
+exactly the tokens the dead replica would have produced. Sampling is greedy
+(argmax) — deterministic by construction.
+
+Admission is vetoed by the memory-watermark plane: the KV arena lives in
+shm where ``mem.pressure`` sees it, and new sequences wait while pressure
+exceeds the configured ceiling or the page pool cannot hold their worst
+case. In-flight sequences always have their pages reserved up front, so a
+step can never die on a full pool.
+"""
+
+from __future__ import annotations
+
+import itertools
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from raydp_tpu import sanitize
+from raydp_tpu.obs import metrics
+from raydp_tpu.serve.kvcache import PagedKVCache
+
+_PAD_SEQ = "_pad"
+
+
+@dataclass
+class _Stream:
+    stream_id: str
+    prompt: List[int]
+    max_new_tokens: int
+    t_submit: float
+    tokens: List[int] = field(default_factory=list)
+    done: bool = False
+    error: Optional[str] = None
+    t_first: Optional[float] = None
+
+
+class DecodeEngine:
+    """One process-local continuous-batching loop over a TransformerLM.
+
+    Standalone-constructible (tests run it without any actor around it);
+    ``ModelReplica`` hosts one per process behind ``decode_submit`` /
+    ``decode_poll`` RPCs. ``model`` must use a non-collective attention
+    impl ("flash" recommended — it is the kernel family ``flash_decode``
+    is parity-gated against).
+    """
+
+    def __init__(
+        self,
+        model,
+        params,
+        *,
+        capacity_tokens: int = 512,
+        page_tokens: int = 128,
+        max_seqs: int = 4,
+        max_new_tokens: int = 64,
+        int8_kv: bool = False,
+        eos_token: Optional[int] = None,
+        max_mem_pressure: float = 0.95,
+    ):
+        self._model = model
+        self._params = params
+        self.capacity_tokens = int(capacity_tokens)
+        self.max_seqs = int(max_seqs)
+        self.max_new_tokens_cap = int(max_new_tokens)
+        self.int8_kv = bool(int8_kv)
+        self.eos_token = eos_token
+        self.max_mem_pressure = float(max_mem_pressure)
+
+        head_dim = model.d_model // model.num_heads
+        self._cache = PagedKVCache(
+            layers=model.num_layers,
+            heads=model.num_heads,
+            head_dim=head_dim,
+            capacity_tokens=self.capacity_tokens,
+            page_tokens=int(page_tokens),
+            max_seqs=self.max_seqs + 1,  # + the pad sequence's page
+            int8=self.int8_kv,
+        )
+        self._cache.alloc(_PAD_SEQ)
+        zero = np.zeros((model.num_layers, model.num_heads, 1, head_dim),
+                        np.float32)
+        self._cache.append(_PAD_SEQ, zero, zero)
+
+        import jax
+
+        self._prefill_fn = jax.jit(
+            lambda p, toks: model.apply(p, toks, return_kv=True)
+        )
+        self._decode_fn = jax.jit(
+            lambda p, toks, kv_len, caches: model.apply(
+                p, toks, kv_caches=caches, kv_len=kv_len
+            )
+        )
+
+        self._lock = sanitize.named_lock("serve.decode", threading.Lock())
+        # guarded-by: self._lock
+        self._pending: deque = deque()
+        self._streams: Dict[str, _Stream] = {}
+        self._slots: List[Optional[str]] = [None] * self.max_seqs
+        self._ids = itertools.count()
+        self._closed = False
+        self._wake = threading.Event()
+
+        self._m_tokens = metrics.counter("serve.decode.tokens")
+        self._m_steps = metrics.counter("serve.decode.steps")
+        self._m_prefills = metrics.counter("serve.decode.prefills")
+        self._m_vetoed = metrics.counter("serve.decode.admission_vetoed")
+        self._g_inflight = metrics.gauge("serve.decode.inflight")
+        self._g_queued = metrics.gauge("serve.decode.queued")
+        self._h_fill = metrics.histogram("serve.decode.batch_fill")
+        self._h_step = metrics.histogram("serve.decode.step_s")
+        self._h_ttft = metrics.histogram("serve.ttft_ms")
+
+        self._thread = threading.Thread(
+            target=self._loop, name="serve-decode", daemon=True
+        )
+        self._thread.start()
+
+    # -- client surface ------------------------------------------------
+
+    def submit(
+        self,
+        prompt_tokens: Sequence[int],
+        max_new_tokens: int,
+        stream_id: Optional[str] = None,
+    ) -> str:
+        """Queue a sequence; returns a stream id to ``poll``. The prompt
+        must fit the cache with its worst-case continuation."""
+        prompt = [int(t) for t in prompt_tokens]
+        max_new = min(int(max_new_tokens), self.max_new_tokens_cap)
+        if not prompt:
+            raise ValueError("empty prompt")
+        if max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        if len(prompt) + max_new > self.capacity_tokens:
+            raise ValueError(
+                f"prompt ({len(prompt)}) + max_new ({max_new}) exceeds "
+                f"cache capacity {self.capacity_tokens}"
+            )
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("decode engine closed")
+            sid = stream_id or f"s{next(self._ids)}"
+            if sid in self._streams:
+                raise ValueError(f"stream {sid!r} already exists")
+            stream = _Stream(sid, prompt, max_new, time.monotonic())
+            self._streams[sid] = stream
+            self._pending.append(stream)
+            self._g_queued.set(float(len(self._pending)))
+        self._wake.set()
+        return sid
+
+    def poll(self, stream_id: str, cursor: int = 0) -> dict:
+        """Tokens emitted at or after ``cursor`` plus terminal state —
+        the polling half of the streaming API (request/response-shaped so
+        it rides the ordinary actor RPC path)."""
+        with self._lock:
+            stream = self._streams.get(stream_id)
+            if stream is None:
+                raise KeyError(f"unknown stream {stream_id!r}")
+            out = {
+                "tokens": list(stream.tokens[int(cursor):]),
+                "done": stream.done,
+                "error": stream.error,
+            }
+            if stream.done:
+                # terminal poll retires the bookkeeping once drained
+                if int(cursor) + len(out["tokens"]) >= len(stream.tokens):
+                    self._streams.pop(stream_id, None)
+        return out
+
+    def generate(
+        self, prompt_tokens: Sequence[int], max_new_tokens: int,
+        timeout: float = 60.0,
+    ) -> List[int]:
+        """Blocking convenience wrapper: submit + drain one stream."""
+        sid = self.submit(prompt_tokens, max_new_tokens)
+        deadline = time.monotonic() + timeout
+        tokens: List[int] = []
+        while True:
+            res = self.poll(sid, len(tokens))
+            tokens.extend(res["tokens"])
+            if res["error"]:
+                raise RuntimeError(res["error"])
+            if res["done"]:
+                return tokens
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"stream {sid} timed out")
+            time.sleep(0.002)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "inflight": sum(1 for s in self._slots if s is not None),
+                "queued": len(self._pending),
+                "streams": len(self._streams),
+                "kv_pages_free": self._cache.free_pages,
+                "kv_bytes": self._cache.nbytes,
+            }
+
+    def close(self) -> None:
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+            for stream in self._streams.values():
+                if not stream.done:
+                    stream.done = True
+                    stream.error = "decode engine closed"
+            self._pending.clear()
+        self._wake.set()
+        self._thread.join(timeout=10.0)
+        self._cache.close()
+        self._g_inflight.set(0.0)
+        self._g_queued.set(0.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+    # -- engine loop ---------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            with self._lock:
+                if self._closed:
+                    return
+            try:
+                worked = self._admit()
+                worked = self._step() or worked
+            except Exception as exc:  # noqa: BLE001 - engine must not die silently
+                from raydp_tpu import obs
+
+                obs.log.warning("decode engine step failed", exc_info=True)
+                self._fail_all(exc)
+                return
+            if not worked:
+                self._wake.wait(0.005)
+                self._wake.clear()
+
+    def _fail_all(self, exc: BaseException) -> None:
+        with self._lock:
+            for stream in self._streams.values():
+                if not stream.done:
+                    stream.done = True
+                    stream.error = f"{type(exc).__name__}: {exc}"
+            self._pending.clear()
+            self._slots = [None] * self.max_seqs
+            self._g_inflight.set(0.0)
+
+    def _mem_pressure(self) -> float:
+        try:
+            from raydp_tpu.obs.profiler import current_mem_pressure
+
+            return float(current_mem_pressure())
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (no samples yet = no veto signal)
+            return 0.0
+
+    def _admit(self) -> bool:
+        """Move pending sequences into free slots: prefill their prompt at
+        the fixed [1, capacity] shape, warm their KV pages, and emit the
+        first token. Vetoed (not failed) while the page pool or the
+        memory-watermark plane says no."""
+        admitted = False
+        while True:
+            with self._lock:
+                if not self._pending:
+                    break
+                try:
+                    slot = self._slots.index(None)
+                except ValueError:  # raydp-lint: disable=swallowed-exceptions (no free slot is the normal full-batch state, not an error; admission resumes when a stream retires)
+                    break
+                stream = self._pending[0]
+                worst_case = len(stream.prompt) + stream.max_new_tokens
+                if not self._cache.can_admit(worst_case):
+                    self._m_vetoed.inc()
+                    break
+                self._pending.popleft()
+                self._g_queued.set(float(len(self._pending)))
+            if self._mem_pressure() > self.max_mem_pressure:
+                # put it back and stop admitting until pressure drains
+                with self._lock:
+                    self._pending.appendleft(stream)
+                    self._g_queued.set(float(len(self._pending)))
+                self._m_vetoed.inc()
+                break
+
+            t0 = time.perf_counter()
+            prompt = stream.prompt
+            length = len(prompt)
+            toks = np.zeros((1, self.capacity_tokens), np.int32)
+            toks[0, :length] = prompt
+            import jax.numpy as jnp
+
+            logits, new_kv = self._prefill_fn(self._params, jnp.asarray(toks))
+            logits = np.asarray(logits)
+            self._cache.alloc(stream.stream_id)
+            k_rows = np.stack(
+                [np.asarray(k)[0, :, :length] for k, _ in new_kv]
+            ).astype(np.float32)
+            v_rows = np.stack(
+                [np.asarray(v)[0, :, :length] for _, v in new_kv]
+            ).astype(np.float32)
+            self._cache.append(stream.stream_id, k_rows, v_rows)
+            first = int(np.argmax(logits[0, length - 1]))
+            self._m_prefills.inc()
+            self._emit(stream, first, slot=slot)
+            metrics.histogram("serve.decode.prefill_s").observe(
+                time.perf_counter() - t0
+            )
+            admitted = True
+        return admitted
+
+    def _emit(self, stream: _Stream, token: int, slot: Optional[int] = None) -> None:
+        now = time.monotonic()
+        with self._lock:
+            stream.tokens.append(int(token))
+            if stream.t_first is None:
+                stream.t_first = now
+                self._h_ttft.observe((now - stream.t_submit) * 1000.0)
+            self._m_tokens.inc()
+            finished = (
+                len(stream.tokens) >= stream.max_new_tokens
+                or (self.eos_token is not None and token == self.eos_token)
+            )
+            if finished:
+                stream.done = True
+                if slot is None and stream.stream_id in self._slots:
+                    slot = self._slots.index(stream.stream_id)
+                if slot is not None and self._slots[slot] == stream.stream_id:
+                    self._slots[slot] = None
+                self._cache.free(stream.stream_id)
+            elif slot is not None:
+                self._slots[slot] = stream.stream_id
+            self._g_inflight.set(
+                float(sum(1 for s in self._slots if s is not None))
+            )
+
+    def _step(self) -> bool:
+        """One continuous-batching decode iteration over every occupied
+        slot, at the fixed [max_seqs, 1] shape (pad slots masked out)."""
+        with self._lock:
+            slots = list(self._slots)
+            active = [
+                (i, self._streams[sid])
+                for i, sid in enumerate(slots) if sid is not None
+            ]
+        if not active:
+            return False
+
+        t0 = time.perf_counter()
+        seq_ids = [sid if sid is not None else _PAD_SEQ for sid in slots]
+        toks = np.zeros((self.max_seqs, 1), np.int32)
+        kv_len = np.ones(self.max_seqs, np.int32)
+        for i, stream in active:
+            toks[i, 0] = stream.tokens[-1]
+            kv_len[i] = self._cache.length(stream.stream_id) + 1
+
+        import jax.numpy as jnp
+
+        gathered = self._cache.gather(seq_ids)
+        if self.int8_kv:
+            k8, ks, v8, vs = gathered
+            caches = [
+                (jnp.asarray(k8[ly]), jnp.asarray(ks[ly]),
+                 jnp.asarray(v8[ly]), jnp.asarray(vs[ly]))
+                for ly in range(k8.shape[0])
+            ]
+        else:
+            k, v = gathered
+            caches = [
+                (jnp.asarray(k[ly]), jnp.asarray(v[ly]))
+                for ly in range(k.shape[0])
+            ]
+
+        logits, new_kv = self._decode_fn(
+            self._params, jnp.asarray(toks), jnp.asarray(kv_len), caches
+        )
+        logits = np.asarray(logits)
+
+        for i, stream in active:
+            k_rows = np.stack(
+                [np.asarray(k)[i] for k, _ in new_kv]
+            ).astype(np.float32)
+            v_rows = np.stack(
+                [np.asarray(v)[i] for _, v in new_kv]
+            ).astype(np.float32)
+            self._cache.append(stream.stream_id, k_rows, v_rows)
+            self._emit(stream, int(np.argmax(logits[i, -1])))
+
+        step_s = time.perf_counter() - t0
+        self._m_steps.inc()
+        self._h_step.observe(step_s)
+        self._h_fill.observe(len(active) / float(self.max_seqs))
+        metrics.histogram("serve.decode.token_ms").observe(
+            step_s * 1000.0 / len(active)
+        )
+        from raydp_tpu import obs
+
+        obs.flush_throttled()
+        return True

@@ -50,6 +50,7 @@ class Deployment:
         self._name = spec.name
         self._closed = False
         self._next_idx = 0
+        self._next_stream = 0  # round-robin cursor for decode streams
         self._lock = sanitize.named_lock(
             "serve.deployment", threading.RLock()
         )
@@ -238,6 +239,90 @@ class Deployment:
         yields the prediction rows."""
         return self.batcher.submit(payload)
 
+    # -- decode streaming (docs/serving.md, "Decode serving") -----------
+
+    def _pick_decode_handle(self):
+        with self._lock:
+            if not self._handles:
+                raise ClusterError("no live replicas")
+            handle = self._handles[self._next_stream % len(self._handles)]
+            self._next_stream += 1
+        return handle
+
+    def stream(self, prompt_tokens, max_new_tokens: int,
+               timeout: float = 120.0):
+        """Stream generated tokens for one prompt (generator of ints).
+
+        Picks a replica round-robin, submits to its continuous-batching
+        decode engine, and polls tokens out as they land. On replica
+        death or reload mid-stream the deployment heals and RESUBMITS to
+        a survivor with prompt + already-emitted tokens as the prefix —
+        the KV cache is re-prefilled there, and because a decode step is
+        bit-identical to a prefill over the same tokens (the kernel-family
+        parity contract, f32 cache), the continuation carries on with
+        exactly the tokens the dead replica would have produced. No token
+        is ever emitted twice and none is lost: zero-drop re-admission,
+        stream edition."""
+        import time
+
+        from raydp_tpu.serve.batcher import _RETRYABLE
+
+        prompt = [int(t) for t in prompt_tokens]
+        max_new = int(max_new_tokens)
+        emitted: List[int] = []
+        deadline = time.monotonic() + timeout
+        failovers = 0
+        rpc_timeout = self._conf.request_timeout_s
+        while True:
+            try:
+                handle = self._pick_decode_handle()
+                sid = handle.decode_submit.options(
+                    timeout=rpc_timeout
+                ).remote(prompt + emitted, max_new - len(emitted)).result()
+                cursor = 0
+                while True:
+                    res = handle.decode_poll.options(
+                        timeout=rpc_timeout
+                    ).remote(sid, cursor).result()
+                    new = res["tokens"]
+                    cursor += len(new)
+                    for tok in new:
+                        emitted.append(int(tok))
+                        yield int(tok)
+                    if res["error"]:
+                        # engine-side failure (e.g. retired by a reload
+                        # mid-stream): same recovery as a dead replica
+                        raise ClusterError(res["error"])
+                    if res["done"]:
+                        return
+                    if time.monotonic() > deadline:
+                        raise TimeoutError(
+                            f"decode stream timed out after {timeout}s "
+                            f"({len(emitted)}/{max_new} tokens)"
+                        )
+                    time.sleep(0.003)
+            except _RETRYABLE + (KeyError,):
+                failovers += 1
+                if failovers > self._conf.max_retries:
+                    raise
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"decode stream timed out after {timeout}s "
+                        f"({len(emitted)}/{max_new} tokens)"
+                    )
+                obs.log.warning(
+                    "decode stream failover: re-prefilling on a survivor",
+                    deployment=self._name, emitted=len(emitted),
+                    exc_info=True,
+                )
+                obs.metrics.counter("serve.decode.failovers").inc()
+                self.heal()
+
+    def generate(self, prompt_tokens, max_new_tokens: int,
+                 timeout: float = 120.0) -> List[int]:
+        """Blocking convenience over ``stream``: the full token list."""
+        return list(self.stream(prompt_tokens, max_new_tokens, timeout))
+
     # -- lifecycle ------------------------------------------------------
 
     def reload(self) -> List[dict]:
@@ -340,12 +425,24 @@ def deploy(
             "deploy needs an estimator, or model= plus checkpoint_dir="
         )
     resolved = ServeConf.resolve(conf)
+    decode_kwargs = {}
+    if resolved.decode:
+        decode_kwargs = {
+            "capacity_tokens": resolved.decode_capacity_tokens,
+            "page_tokens": resolved.decode_page_tokens,
+            "max_seqs": resolved.decode_max_seqs,
+            "max_new_tokens": resolved.decode_max_new_tokens,
+            "int8_kv": resolved.decode_int8_kv,
+            "eos_token": resolved.decode_eos_token,
+            "max_mem_pressure": resolved.max_mem_pressure,
+        }
     spec = ReplicaSpec(
         model=model,
         checkpoint_dir=checkpoint_dir,
         buckets=resolved.buckets,
         example=example,
         name=name,
+        decode=decode_kwargs,
     )
     return Deployment(
         spec, resolved, replicas=replicas, feature_columns=feature_columns

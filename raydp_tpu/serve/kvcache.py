@@ -1,0 +1,302 @@
+"""Paged KV cache for incremental decode, backed by the shm block store.
+
+One shared-memory arena (``store.create_block(storage="shm")``) holds a pool
+of fixed-size pages; each sequence owns a block table (list of page ids) and
+a valid length. Decode steps ``append`` the newest K/V rows and ``gather``
+dense per-layer [B, H, Tcap, D] tensors for ``ops.flash_decode`` — positions
+at or past a sequence's length are garbage by design and masked inside the
+kernel by ``kv_len``.
+
+Living in shm (``rtpu-`` prefix) makes the cache a first-class citizen of
+the memory-watermark plane: ``mem.shm_bytes`` / ``mem.pressure`` see every
+page the moment the arena is created, the admission controller in
+``serve.decode`` can veto new sequences on pressure, and the leak audit
+fails shutdown if an arena outlives its engine.
+
+Optional int8 mode stores quantized K/V values plus per-row (per position,
+per head) f32 scales from ``ops.quantization.quantize_int8``; the decode
+kernel dequantizes on the fly. f32 mode is bit-exact — the mode the
+decode-vs-prefill determinism contract is stated for.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict, List, Sequence
+
+import numpy as np
+
+from raydp_tpu.obs import metrics
+
+DEFAULT_PAGE_TOKENS = 128
+
+
+class KVCacheFull(RuntimeError):
+    """No free pages — the admission controller should defer, not crash."""
+
+
+class PagedKVCache:
+    """Page-pool KV cache with per-sequence block tables.
+
+    layers/heads/head_dim: model geometry (one pool spans all layers).
+    capacity_tokens: per-sequence maximum length (multiple of page_tokens);
+        the fixed shape the decode kernel compiles against.
+    max_seqs: sizes the default pool (``max_seqs`` full-length sequences).
+    int8: store int8 values + per-row f32 scales instead of f32 values.
+    """
+
+    def __init__(
+        self,
+        *,
+        layers: int,
+        heads: int,
+        head_dim: int,
+        capacity_tokens: int,
+        page_tokens: int = DEFAULT_PAGE_TOKENS,
+        max_seqs: int = 8,
+        pool_pages: int | None = None,
+        int8: bool = False,
+        storage: str = "shm",
+    ):
+        if capacity_tokens % page_tokens:
+            raise ValueError(
+                f"capacity_tokens {capacity_tokens} must be a multiple of "
+                f"page_tokens {page_tokens}"
+            )
+        self.layers = layers
+        self.heads = heads
+        self.head_dim = head_dim
+        self.capacity_tokens = capacity_tokens
+        self.page_tokens = page_tokens
+        self.pages_per_seq = capacity_tokens // page_tokens
+        self.pool_pages = pool_pages or max_seqs * self.pages_per_seq
+        self.int8 = int8
+
+        page_rows = page_tokens * heads
+        val_itemsize = 1 if int8 else 4
+        self._val_bytes = (
+            layers * 2 * self.pool_pages * page_rows * head_dim * val_itemsize
+        )
+        self._scale_bytes = (
+            layers * 2 * self.pool_pages * page_rows * 4 if int8 else 0
+        )
+        total = self._val_bytes + self._scale_bytes
+
+        from raydp_tpu.store.object_store import (
+            ObjectRef, _register, create_block, current_owner,
+        )
+
+        self._block = create_block(total, storage=storage)
+        # Register the arena with the head under THIS process's owner id
+        # (the replica actor in serving). A replica SIGKILLed mid-decode
+        # then strands no KV memory: actor death fires the head's
+        # owner-GC (`_on_owner_dead`), which unlinks the segment like any
+        # owned block — an unsealed block is otherwise known only to its
+        # creator, and a SIGKILL would orphan it forever. Explicit owner:
+        # the block-service handoff must never adopt the arena (it would
+        # outlive the replica, which is exactly backwards). Best-effort —
+        # a standalone engine (unit tests, driver-side experiments) has
+        # no head; there the creator's abort() + leak audit cover it.
+        self._ref = None
+        try:
+            ref = ObjectRef(self._block.object_id, total)
+            _register(ref, current_owner())
+            self._ref = ref
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (no cluster: standalone engines clean up via abort(); nothing to GC head-side)
+            self._ref = None
+        view = self._block.writable_view()
+        val_dtype = np.int8 if int8 else np.float32
+        # [layer, k/v, page, token, head, dim] — token-major rows inside a
+        # page so a page is a contiguous run of quantization rows
+        self._vals = np.frombuffer(
+            view, dtype=val_dtype, count=self._val_bytes // val_itemsize
+        ).reshape(layers, 2, self.pool_pages, page_tokens, heads, head_dim)
+        if int8:
+            self._scales = np.frombuffer(
+                view, dtype=np.float32, count=self._scale_bytes // 4,
+                offset=self._val_bytes,
+            ).reshape(layers, 2, self.pool_pages, page_tokens, heads)
+        else:
+            self._scales = None
+
+        self._lock = threading.Lock()
+        self._free: List[int] = list(range(self.pool_pages))
+        self._tables: Dict[str, List[int]] = {}
+        self._lengths: Dict[str, int] = {}
+        self._closed = False
+        self.nbytes = total
+        metrics.gauge("serve.kv.bytes").set_watermark(float(total))
+        metrics.gauge("serve.kv.pages_total").set(float(self.pool_pages))
+        self._update_gauges()
+
+    # -- bookkeeping --------------------------------------------------------
+
+    def _update_gauges(self) -> None:
+        metrics.gauge("serve.kv.pages_free").set(float(len(self._free)))
+        metrics.gauge("serve.kv.seqs").set(float(len(self._tables)))
+
+    def alloc(self, seq_id: str) -> None:
+        with self._lock:
+            if seq_id in self._tables:
+                raise ValueError(f"sequence {seq_id!r} already allocated")
+            self._tables[seq_id] = []
+            self._lengths[seq_id] = 0
+            self._update_gauges()
+
+    def free(self, seq_id: str) -> None:
+        with self._lock:
+            pages = self._tables.pop(seq_id, [])
+            self._lengths.pop(seq_id, None)
+            self._free.extend(pages)
+            self._update_gauges()
+
+    def length(self, seq_id: str) -> int:
+        return self._lengths[seq_id]
+
+    def lengths(self, seq_ids: Sequence[str]) -> np.ndarray:
+        return np.asarray([self._lengths[s] for s in seq_ids], np.int32)
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    def pages_needed(self, n_tokens: int) -> int:
+        return -(-n_tokens // self.page_tokens)
+
+    def can_admit(self, n_tokens: int) -> bool:
+        return len(self._free) >= self.pages_needed(n_tokens)
+
+    # -- data path ----------------------------------------------------------
+
+    def append(self, seq_id: str, k_new: np.ndarray, v_new: np.ndarray) -> None:
+        """Write the newest K/V rows. k_new/v_new: [layers, heads, t, dim]
+        float32 (model output layout). Grows the block table as pages fill;
+        raises KVCacheFull when the pool is dry (caller defers admission —
+        in-flight sequences always have their pages already)."""
+        t = k_new.shape[2]
+        with self._lock:
+            table = self._tables[seq_id]
+            start = self._lengths[seq_id]
+            if start + t > self.capacity_tokens:
+                raise ValueError(
+                    f"sequence {seq_id!r} would exceed capacity "
+                    f"{self.capacity_tokens} ({start}+{t})"
+                )
+            need = self.pages_needed(start + t) - len(table)
+            if need > len(self._free):
+                raise KVCacheFull(
+                    f"need {need} pages, {len(self._free)} free"
+                )
+            for _ in range(need):
+                table.append(self._free.pop())
+            self._lengths[seq_id] = start + t
+            self._update_gauges()
+
+        # [heads, t, dim] -> token-major [t, heads, dim] rows
+        k_rows = np.ascontiguousarray(
+            np.transpose(k_new, (0, 2, 1, 3)), dtype=np.float32
+        )
+        v_rows = np.ascontiguousarray(
+            np.transpose(v_new, (0, 2, 1, 3)), dtype=np.float32
+        )
+        if self.int8:
+            k_rows, k_sc = _quantize_rows(k_rows)
+            v_rows, v_sc = _quantize_rows(v_rows)
+
+        pos = start
+        off = 0
+        while off < t:
+            page_idx = table[pos // self.page_tokens]
+            in_page = pos % self.page_tokens
+            n = min(self.page_tokens - in_page, t - off)
+            sl = slice(in_page, in_page + n)
+            src = slice(off, off + n)
+            self._vals[:, 0, page_idx, sl] = k_rows[:, src]
+            self._vals[:, 1, page_idx, sl] = v_rows[:, src]
+            if self.int8:
+                self._scales[:, 0, page_idx, sl] = k_sc[:, src]
+                self._scales[:, 1, page_idx, sl] = v_sc[:, src]
+            pos += n
+            off += n
+
+    def gather(self, seq_ids: Sequence[str]):
+        """Dense per-layer cache tensors for a decode batch.
+
+        f32 mode: (k, v) each [layers, B, heads, Tcap, dim] float32.
+        int8 mode: (k, k_scale, v, v_scale) — values int8, scales
+        [layers, B, heads, Tcap] float32.
+
+        Unwritten positions are whatever the pool holds — the decode kernel
+        masks them via kv_len, so no zero-fill pass is spent on them."""
+        with self._lock:
+            tables = []
+            for s in seq_ids:
+                table = self._tables[s]
+                pad = self.pages_per_seq - len(table)
+                # pad with page 0: masked by kv_len, never read meaningfully
+                tables.append(table + [0] * pad)
+            page_ids = np.asarray(tables, np.int64)  # [B, pages_per_seq]
+
+        # [layers, 2, B, pages, page_tokens, heads, dim]
+        vals = self._vals[:, :, page_ids]
+        ly, _, bsz = vals.shape[:3]
+        dense = vals.reshape(
+            ly, 2, bsz, self.capacity_tokens, self.heads, self.head_dim
+        ).transpose(0, 1, 2, 4, 3, 5)  # [layers, 2, B, heads, Tcap, dim]
+        k, v = dense[:, 0], dense[:, 1]
+        if not self.int8:
+            return np.ascontiguousarray(k), np.ascontiguousarray(v)
+        sc = self._scales[:, :, page_ids].reshape(
+            ly, 2, bsz, self.capacity_tokens, self.heads
+        ).transpose(0, 1, 2, 4, 3)  # [layers, 2, B, heads, Tcap]
+        return (
+            np.ascontiguousarray(k),
+            np.ascontiguousarray(sc[:, 0]),
+            np.ascontiguousarray(v),
+            np.ascontiguousarray(sc[:, 1]),
+        )
+
+    def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
+        self._vals = None
+        self._scales = None
+        if self._ref is not None:
+            # graceful retirement: drop the head's ownership record first
+            # so the owner-GC has nothing left to do when the actor exits
+            from raydp_tpu.store.object_store import delete
+
+            try:
+                delete([self._ref])
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (head already gone at teardown: its shutdown unlinked the segment)
+                pass
+            self._ref = None
+        try:
+            self._block.abort()
+        except BufferError:  # raydp-lint: disable=swallowed-exceptions (a live numpy view pins the mmap; unlink still frees the name)
+            pass
+        metrics.gauge("serve.kv.bytes").set(0.0)
+        metrics.gauge("serve.kv.pages_free").set(0.0)
+        metrics.gauge("serve.kv.seqs").set(0.0)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+def _quantize_rows(x: np.ndarray):
+    """Per-row int8 quantization of [layers, t, heads, dim] rows (row = one
+    position of one head), matching ``ops.quantization.quantize_int8``'s
+    deterministic path so the kernel-side dequant is the exact inverse
+    scale."""
+    from raydp_tpu.ops.quantization import quantize_int8
+
+    ly, t, h, d = x.shape
+    vals, scales = quantize_int8(x.reshape(ly * t * h, d))
+    return (
+        np.asarray(vals).reshape(ly, t, h, d),
+        np.asarray(scales).reshape(ly, t, h),
+    )

@@ -52,6 +52,9 @@ class ReplicaSpec:
     example: Any = None
     name: str = "default"
     extra_estimator_kwargs: dict = field(default_factory=dict)
+    # DecodeEngine kwargs (serve/decode.py) — non-empty enables the
+    # decode_submit/decode_poll streaming surface on each replica
+    decode: dict = field(default_factory=dict)
 
 
 class _ModelState:
@@ -60,7 +63,10 @@ class _ModelState:
     a concurrent reload (which builds a whole new _ModelState and swaps the
     reference) can never expose a torn view."""
 
-    __slots__ = ("fingerprint", "epoch", "step", "params", "jitted", "compiled")
+    __slots__ = (
+        "fingerprint", "epoch", "step", "params", "jitted", "compiled",
+        "flops",
+    )
 
     def __init__(self, fingerprint, epoch, step, params, jitted):
         self.fingerprint = fingerprint
@@ -69,6 +75,7 @@ class _ModelState:
         self.params = params
         self.jitted = jitted
         self.compiled = {}  # shape key -> AOT-compiled executable
+        self.flops = {}  # shape key -> XLA-reported FLOPs per call (or None)
 
     def _shape_key(self, x):
         if isinstance(x, tuple):
@@ -100,13 +107,44 @@ class _ModelState:
                     jax.tree.map(sds, self.params), fmap(sds, x)
                 ).compile()
             obs.metrics.counter("serve.replica.compiles").inc()
+            # FLOP-account the new executable HERE, inside the one-time
+            # compile path (boot warm / first-touch): cost_analysis() is
+            # not free, and charging it to the first REQUEST per bucket
+            # puts a one-off spike straight into that request's latency —
+            # at bench request counts those few spikes ARE the p99
+            from raydp_tpu.obs.costmodel import step_flops_from_compiled
+
+            self.flops[key] = step_flops_from_compiled(fn)
             while len(self.compiled) >= self.MAX_COMPILED:
                 try:
-                    self.compiled.pop(next(iter(self.compiled)), None)
+                    evicted = next(iter(self.compiled))
+                    self.compiled.pop(evicted, None)
+                    self.flops.pop(evicted, None)
                 except (StopIteration, RuntimeError):  # raydp-lint: disable=swallowed-exceptions (a racing evictor emptied/mutated the dict first; the cache is already under its bound)
                     break
             self.compiled[key] = fn
         return fn
+
+    def flops_for(self, x):
+        """XLA's per-call FLOP count for this batch shape (None when the
+        backend doesn't report, or before the shape's compile recorded
+        it) — the numerator of the live serve.mfu gauge. A pure cache
+        read: the request path must never pay the analysis."""
+        return self.flops.get(self._shape_key(x))
+
+
+_PEAK_FLOPS = None
+
+
+def _device_peak():
+    """Cached peak FLOP/s of this replica's device (obs/costmodel.py table;
+    None when unknown — the mfu gauge then simply never moves)."""
+    global _PEAK_FLOPS
+    if _PEAK_FLOPS is None:
+        from raydp_tpu.obs.costmodel import device_peak_flops
+
+        _PEAK_FLOPS = device_peak_flops()
+    return _PEAK_FLOPS.get("peak")
 
 
 class ModelReplica:
@@ -122,6 +160,12 @@ class ModelReplica:
 
         self._reload_lock = sanitize.named_lock(
             "serve.replica_reload", threading.Lock()
+        )
+        # lazy decode engine (serve/decode.py): built on the first
+        # decode_submit so non-streaming deployments pay nothing
+        self._decode = None
+        self._decode_lock = sanitize.named_lock(
+            "serve.replica_decode", threading.Lock()
         )
         from raydp_tpu.estimator.jax_estimator import JaxEstimator
 
@@ -164,6 +208,14 @@ class ModelReplica:
                     if int(bucket) >= len(f0(rows)):
                         state.compiled_for(pad_rows(rows, int(bucket)))
             self._active = state  # the atomic swap: new weights go live here
+            # a live decode engine holds the OLD params captured in its
+            # jits — retire it; the next decode_submit rebuilds against
+            # the new generation (in-flight streams fail-fast and the
+            # client re-prefills, same as a replica death)
+            with self._decode_lock:
+                stale, self._decode = self._decode, None
+            if stale is not None:
+                stale.close()
             obs.metrics.counter("serve.replica.reloads").inc()
             obs.flush_throttled()
             return self.info()
@@ -194,6 +246,13 @@ class ModelReplica:
         obs.metrics.counter("serve.replica.infers").inc()
         obs.metrics.counter("serve.replica.rows").inc(int(n_valid))
         obs.metrics.histogram("serve.replica.compute_s").observe(compute_s)
+        # live serving MFU: XLA-reported FLOPs of this exact compiled shape
+        # over measured compute, against the device's table peak — the
+        # serving-plane twin of the estimator's fit-loop mfu gauge
+        flops = state.flops_for(x)
+        peak = _device_peak()
+        if flops and peak and compute_s > 0:
+            obs.metrics.gauge("serve.mfu").set(flops / compute_s / peak)
         obs.flush_throttled()
         return out, compute_s
 
@@ -201,6 +260,40 @@ class ModelReplica:
         """Pick up the newest checkpoint (rolling reload entry point). Old
         weights serve until the new generation is restored and warm."""
         return self._load()
+
+    # -- decode serving (docs/serving.md, "Decode serving") ------------
+
+    def _decode_engine(self):
+        engine = self._decode
+        if engine is not None:
+            return engine
+        with self._decode_lock:
+            if self._decode is None:
+                from raydp_tpu.serve.decode import DecodeEngine
+
+                state = self._active
+                self._decode = DecodeEngine(
+                    self._est._module, state.params,
+                    **dict(self._spec.decode or {}),
+                )
+            return self._decode
+
+    def decode_submit(
+        self, prompt_tokens, max_new_tokens: int, stream_id=None
+    ) -> str:
+        """Queue an autoregressive generation on this replica's
+        continuous-batching engine; returns the stream id to poll."""
+        return self._decode_engine().submit(
+            prompt_tokens, max_new_tokens, stream_id
+        )
+
+    def decode_poll(self, stream_id: str, cursor: int = 0) -> dict:
+        """Tokens at/after ``cursor`` plus terminal state for a stream."""
+        return self._decode_engine().poll(stream_id, cursor)
+
+    def decode_stats(self) -> dict:
+        engine = self._decode
+        return engine.stats() if engine is not None else {}
 
     def warm(self, example) -> int:
         """Precompile every configured bucket for ``example``'s row shape;

@@ -412,6 +412,17 @@ class WritableBlock:
 
         return pa.FixedSizeBufferWriter(pa.py_buffer(self._mmap))
 
+    def writable_view(self) -> memoryview:
+        """A writable memoryview over the raw segment, for callers that use
+        the block as a long-lived mutable arena (the serve KV cache) rather
+        than a seal-once IPC sink. The block stays unsealed; release with
+        ``abort()`` when the arena is retired. Living in shm keeps the arena
+        visible to the memory-watermark plane (``mem.shm_bytes`` scans
+        /dev/shm) and the leak audit."""
+        if self._sealed:
+            raise ClusterError("block already sealed")
+        return memoryview(self._mmap)
+
     def _close_mapping(self) -> None:
         try:
             self._mmap.close()

@@ -1,0 +1,311 @@
+"""Decode-native serving tests (raydp_tpu/serve/{kvcache,decode}.py,
+batcher chunking; docs/serving.md "Decode serving").
+
+- PagedKVCache: exact f32 round-trip through the paged shm arena across
+  page boundaries, block-table growth, free-list reuse, admission
+  arithmetic, int8 mode within the quantization bound;
+- DecodeEngine: greedy continuous-batching decode matches a
+  full-prefill-per-token reference rollout exactly (the kernel parity
+  contract surfacing at the token level), including with concurrent
+  streams sharing steps;
+- batcher oversized-payload chunking: a payload bigger than every bucket
+  dispatches as bucket-shaped chunks and reassembles — a raw shape never
+  reaches a replica;
+- ServeConf decode knob resolution.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raydp_tpu.serve.kvcache import KVCacheFull, PagedKVCache
+
+GEOM = dict(layers=2, heads=2, head_dim=8)
+
+
+def _rows(t, seed=0, layers=2, heads=2, head_dim=8):
+    rng = np.random.default_rng(seed)
+    k = rng.standard_normal((layers, heads, t, head_dim)).astype(np.float32)
+    v = rng.standard_normal((layers, heads, t, head_dim)).astype(np.float32)
+    return k, v
+
+
+# ---------------------------------------------------------------------------
+# PagedKVCache
+# ---------------------------------------------------------------------------
+
+
+def test_kvcache_f32_roundtrip_across_pages():
+    """Appends spanning page boundaries (7+9+5 tokens over 8-token pages)
+    must gather back the exact rows — float32 pages are bit-exact, the
+    mode the determinism contract is stated for."""
+    with PagedKVCache(capacity_tokens=32, page_tokens=8, max_seqs=2,
+                      **GEOM) as cache:
+        cache.alloc("s")
+        k1, v1 = _rows(7, 1)
+        k2, v2 = _rows(9, 2)
+        k3, v3 = _rows(5, 3)
+        for k, v in ((k1, v1), (k2, v2), (k3, v3)):
+            cache.append("s", k, v)
+        assert cache.length("s") == 21
+        k_all = np.concatenate([k1, k2, k3], axis=2)
+        v_all = np.concatenate([v1, v2, v3], axis=2)
+        k_got, v_got = cache.gather(["s"])
+        np.testing.assert_array_equal(k_got[:, 0, :, :21], k_all)
+        np.testing.assert_array_equal(v_got[:, 0, :, :21], v_all)
+
+
+def test_kvcache_paging_freelist_and_admission():
+    with PagedKVCache(capacity_tokens=16, page_tokens=8, max_seqs=2,
+                      **GEOM) as cache:
+        assert cache.free_pages == 4
+        assert cache.can_admit(16) and not cache.can_admit(40)
+        cache.alloc("a")
+        cache.append("a", *_rows(16, 1))
+        assert cache.free_pages == 2
+        # capacity is per-sequence: one more row must refuse
+        with pytest.raises(ValueError):
+            cache.append("a", *_rows(1, 2))
+        cache.alloc("b")
+        cache.append("b", *_rows(16, 3))
+        assert cache.free_pages == 0
+        cache.alloc("c")
+        with pytest.raises(KVCacheFull):
+            cache.append("c", *_rows(1, 4))
+        # freeing returns pages; a new sequence reuses them with no
+        # residue from the old occupant
+        cache.free("a")
+        assert cache.free_pages == 2
+        kd, vd = _rows(10, 5)
+        cache.append("c", kd, vd)
+        k_got, v_got = cache.gather(["c"])
+        np.testing.assert_array_equal(k_got[:, 0, :, :10], kd)
+        np.testing.assert_array_equal(v_got[:, 0, :, :10], vd)
+
+
+def test_kvcache_int8_within_bound():
+    with PagedKVCache(capacity_tokens=16, page_tokens=8, max_seqs=1,
+                      int8=True, **GEOM) as cache:
+        cache.alloc("s")
+        k, v = _rows(13, 9)
+        cache.append("s", k, v)
+        k8, ks, v8, vs = cache.gather(["s"])
+        k_dq = k8[:, 0, :, :13].astype(np.float32) * ks[:, 0, :, :13, None]
+        v_dq = v8[:, 0, :, :13].astype(np.float32) * vs[:, 0, :, :13, None]
+        # per-row bound: |x - dq| <= scale/2 elementwise
+        assert np.all(np.abs(k_dq - k) <= ks[:, 0, :, :13, None] / 2 + 1e-7)
+        assert np.all(np.abs(v_dq - v) <= vs[:, 0, :, :13, None] / 2 + 1e-7)
+
+
+# ---------------------------------------------------------------------------
+# DecodeEngine
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from raydp_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(
+        vocab_size=64, d_model=32, num_heads=2, num_layers=2,
+        max_len=256, attn_impl="flash", dtype=jnp.float32,
+    )
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    return model, params
+
+
+def _reference_rollout(model, params, prompt, n_new):
+    """Greedy ground truth: full prefill per emitted token."""
+    seq = list(prompt)
+    out = []
+    for _ in range(n_new):
+        logits = model.apply(params, jnp.asarray([seq], jnp.int32))
+        tok = int(jnp.argmax(logits[0, len(seq) - 1]))
+        out.append(tok)
+        seq.append(tok)
+    return out
+
+
+def test_decode_engine_matches_reference_rollout(tiny_lm):
+    from raydp_tpu.serve.decode import DecodeEngine
+
+    model, params = tiny_lm
+    with DecodeEngine(model, params, capacity_tokens=64, page_tokens=16,
+                      max_seqs=2, max_new_tokens=8) as eng:
+        prompt = [5, 9, 2, 7]
+        got = eng.generate(prompt, 6, timeout=120)
+        assert got == _reference_rollout(model, params, prompt, 6)
+
+
+def test_decode_engine_concurrent_streams_are_isolated(tiny_lm):
+    """Three streams admitted together (two slots: continuous batching
+    must rotate them through) each produce exactly their own reference
+    rollout — batch composition independence at the fixed step shape."""
+    from raydp_tpu.serve.decode import DecodeEngine
+
+    model, params = tiny_lm
+    prompts = [[3, 1, 4], [15, 9, 2, 6], [8]]
+    with DecodeEngine(model, params, capacity_tokens=64, page_tokens=16,
+                      max_seqs=2, max_new_tokens=8) as eng:
+        sids = [eng.submit(p, 5) for p in prompts]
+        outs = {}
+        deadline = time.monotonic() + 120
+        while len(outs) < len(sids) and time.monotonic() < deadline:
+            for sid in sids:
+                if sid in outs:
+                    continue
+                res = eng.poll(sid, 0)
+                if res["done"]:
+                    assert not res["error"], res["error"]
+                    outs[sid] = res["tokens"]
+            time.sleep(0.01)
+        assert len(outs) == len(sids)
+        for sid, prompt in zip(sids, prompts):
+            assert outs[sid] == _reference_rollout(model, params, prompt, 5)
+        # every slot retired, every page back in the pool (bar the pad seq)
+        stats = eng.stats()
+        assert stats["inflight"] == 0 and stats["queued"] == 0
+
+
+def test_decode_engine_rejects_over_capacity(tiny_lm):
+    from raydp_tpu.serve.decode import DecodeEngine
+
+    model, params = tiny_lm
+    with DecodeEngine(model, params, capacity_tokens=32, page_tokens=16,
+                      max_seqs=1, max_new_tokens=16) as eng:
+        with pytest.raises(ValueError):
+            eng.submit(list(range(30)), 16)
+        with pytest.raises(ValueError):
+            eng.submit([], 4)
+
+
+def test_decode_engine_eos_stops_early(tiny_lm):
+    from raydp_tpu.serve.decode import DecodeEngine
+
+    model, params = tiny_lm
+    prompt = [5, 9, 2, 7]
+    ref = _reference_rollout(model, params, prompt, 6)
+    eos = ref[2]
+    with DecodeEngine(model, params, capacity_tokens=64, page_tokens=16,
+                      max_seqs=1, max_new_tokens=8, eos_token=eos) as eng:
+        got = eng.generate(prompt, 6, timeout=120)
+        # stops AT the FIRST eos occurrence, inclusive
+        assert got == ref[: ref.index(eos) + 1]
+
+
+# ---------------------------------------------------------------------------
+# batcher oversized-payload chunking
+# ---------------------------------------------------------------------------
+
+
+class _StubInfer:
+    """Replica-handle stand-in recording every dispatched batch shape."""
+
+    def __init__(self, shapes, lock):
+        self._shapes = shapes
+        self._lock = lock
+
+    def options(self, **kw):
+        return self
+
+    def remote(self, padded, n):
+        with self._lock:
+            self._shapes.append(len(padded))
+        out = np.asarray(padded, np.float32) * 2.0
+
+        class _R:
+            def result(self, timeout=None):
+                return out[: int(n)], 0.001
+
+        return _R()
+
+
+class _StubHandle:
+    actor_id = "stub-replica"
+
+    def __init__(self):
+        self.shapes = []
+        self._lock = threading.Lock()
+        self.infer = _StubInfer(self.shapes, self._lock)
+
+
+def test_batcher_chunks_oversized_payload_to_largest_bucket():
+    """A hand-built ladder whose largest bucket is below max_batch_size
+    used to dispatch an over-bucket payload at its RAW shape (compiling
+    an unbounded shape into the replica's cache); it must now go out as
+    bucket-shaped chunks whose rows reassemble client-side."""
+    from raydp_tpu.serve.batcher import DynamicBatcher
+    from raydp_tpu.serve.config import ServeConf
+
+    conf = ServeConf(
+        max_batch_size=16, buckets=(4,), batch_deadline_ms=1.0,
+        dispatchers=1, request_timeout_s=10.0,
+    )
+    batcher = DynamicBatcher(conf)
+    handle = _StubHandle()
+    batcher.add_replica(handle)
+    try:
+        payload = np.arange(13, dtype=np.float32).reshape(13, 1)
+        out = batcher.predict(payload, timeout=30.0)
+        np.testing.assert_array_equal(np.asarray(out), payload * 2.0)
+        # every dispatched shape was a bucket shape — never 13
+        assert handle.shapes, "nothing dispatched"
+        assert set(handle.shapes) == {4}, handle.shapes
+        assert sum(handle.shapes) >= 13
+    finally:
+        batcher.close()
+
+
+def test_batcher_in_bucket_payload_unchanged():
+    """Control: a fitting payload still dispatches as ONE padded bucket."""
+    from raydp_tpu.serve.batcher import DynamicBatcher
+    from raydp_tpu.serve.config import ServeConf
+
+    conf = ServeConf(
+        max_batch_size=16, buckets=(4, 8), batch_deadline_ms=1.0,
+        dispatchers=1, request_timeout_s=10.0,
+    )
+    batcher = DynamicBatcher(conf)
+    handle = _StubHandle()
+    batcher.add_replica(handle)
+    try:
+        payload = np.arange(6, dtype=np.float32).reshape(6, 1)
+        out = batcher.predict(payload, timeout=30.0)
+        np.testing.assert_array_equal(np.asarray(out), payload * 2.0)
+        assert handle.shapes == [8], handle.shapes
+    finally:
+        batcher.close()
+
+
+# ---------------------------------------------------------------------------
+# conf resolution
+# ---------------------------------------------------------------------------
+
+
+def test_serveconf_decode_knobs():
+    from raydp_tpu.serve.config import ServeConf
+
+    conf = ServeConf.resolve({
+        "serve.decode.enabled": True,
+        "serve.decode.capacity_tokens": 128,
+        "serve.decode.page_tokens": 32,
+        "serve.decode.max_seqs": 3,
+        "serve.decode.max_new_tokens": 17,
+        "serve.decode.int8_kv": "true",
+        "serve.decode.eos_token": 2,
+    })
+    assert conf.decode is True
+    assert conf.decode_capacity_tokens == 128
+    assert conf.decode_page_tokens == 32
+    assert conf.decode_max_seqs == 3
+    assert conf.decode_max_new_tokens == 17
+    assert conf.decode_int8_kv is True
+    assert conf.decode_eos_token == 2
+    # defaults: decode off, nothing else changed
+    base = ServeConf.resolve(None)
+    assert base.decode is False and base.decode_eos_token is None

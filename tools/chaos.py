@@ -824,6 +824,152 @@ def scenario_tenant_kill_isolation(rows: int = 60_000) -> dict:
         session_a.stop()
 
 
+def scenario_replica_kill_during_decode(
+    n_streams: int = 4, max_new: int = 12
+) -> dict:
+    """The streaming edition of the serving zero-drop contract
+    (docs/serving.md "Decode serving"): SIGKILL a replica while
+    autoregressive decode streams are in flight on its continuous-batching
+    engine. The deployment heals, and each interrupted stream re-prefills
+    prompt + already-emitted tokens on a survivor. Gates:
+
+    - every stream completes its FULL token budget with zero errors (no
+      stream dropped, no token emitted twice or lost);
+    - tokens IDENTICAL to an unkilled run of the same prompts — an honest
+      gate because greedy argmax over f32 logits at fixed compiled shapes
+      plus the decode-step ≡ prefill kernel bit-parity (gated in
+      tests/test_flash_decode.py) makes the re-prefilled continuation
+      produce exactly the tokens the dead replica would have;
+    - the pool heals back to target replicas.
+
+    The dead replica's paged KV arena is one shm block owned by the
+    replica actor: the head unregisters a killed owner's blocks and
+    unlinks their segments, so the strict shutdown leak audit below also
+    gates that a SIGKILL mid-decode strands no KV memory."""
+    import tempfile
+
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import raydp_tpu
+    from raydp_tpu import obs, serve
+    from raydp_tpu.estimator import JaxEstimator
+    from raydp_tpu.models import TransformerLM
+
+    vocab = 64
+    model = TransformerLM(
+        vocab_size=vocab, d_model=32, num_heads=2, num_layers=2,
+        max_len=256, attn_impl="flash", dtype=jnp.float32,
+    )
+    ckpt_dir = tempfile.mkdtemp(prefix="chaos-decode-ckpt-")
+    est = JaxEstimator(model=model, checkpoint_dir=ckpt_dir)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32))
+    est._save_checkpoint(params, 0, {})
+
+    session = _fresh_session("chaos-decode")
+    dep = None
+    try:
+        dep = serve.deploy(
+            model=model, checkpoint_dir=ckpt_dir, replicas=2,
+            conf={
+                "serve.decode.enabled": True,
+                "serve.decode.capacity_tokens": 128,
+                "serve.decode.page_tokens": 32,
+                "serve.decode.max_seqs": n_streams,
+                "serve.decode.max_new_tokens": max_new,
+            },
+        )
+        target = dep.replica_count()
+        rng = np.random.default_rng(29)
+        prompts = [
+            [int(t) for t in rng.integers(0, vocab, rng.integers(3, 10))]
+            for _ in range(n_streams)
+        ]
+
+        # clean reference run: per-stream tokens depend only on the
+        # stream's own prompt (batch-composition independence, gated in
+        # tests/test_decode_serve.py), so a sequential clean run is a
+        # valid reference for the concurrent killed run
+        clean = [dep.generate(p, max_new, timeout=180) for p in prompts]
+
+        failovers_before = obs.metrics.counter(
+            "serve.decode.failovers"
+        ).value
+        partial: List[list] = [[] for _ in range(n_streams)]
+        results: List[Optional[list]] = [None] * n_streams
+        errors: List[str] = []
+
+        def client(i: int):
+            try:
+                for tok in dep.stream(prompts[i], max_new, timeout=180):
+                    partial[i].append(int(tok))
+                results[i] = list(partial[i])
+            except Exception as exc:  # noqa: BLE001 - the gate reports it
+                errors.append(repr(exc)[:200])
+
+        threads = [
+            threading.Thread(target=client, args=(i,), name=f"decode-{i}")
+            for i in range(n_streams)
+        ]
+        for t in threads:
+            t.start()
+
+        def _fire():
+            # deterministically MID-stream: wait until every stream has
+            # ~2 tokens out (far from its budget of max_new), then kill —
+            # a wall-clock delay can land after the streams finish, which
+            # would make the whole gate vacuous
+            deadline = time.monotonic() + 120
+            while (sum(len(p) for p in partial) < 2 * n_streams
+                   and time.monotonic() < deadline):
+                time.sleep(0.005)
+            try:
+                idx = pick_index(dep.replica_count())
+                dep._handles[idx].kill(no_restart=True)
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (chaos timer: replica may already be gone, racing teardown)
+                pass
+
+        killer = threading.Thread(target=_fire, daemon=True)
+        killer.start()
+        for t in threads:
+            t.join(timeout=240)
+        killer.join()
+
+        failovers = int(
+            obs.metrics.counter("serve.decode.failovers").value
+            - failovers_before
+        )
+        complete = all(
+            r is not None and len(r) == max_new for r in results
+        )
+        identical = complete and not errors and all(
+            r == c for r, c in zip(results, clean)
+        )
+        deadline = time.monotonic() + 20.0
+        while dep.replica_count() < target and time.monotonic() < deadline:
+            time.sleep(0.05)
+        healed = dep.replica_count() == target
+        return {
+            "name": "replica_kill_during_decode",
+            # failovers >= 1: the kill provably interrupted live streams —
+            # token identity with zero failovers would gate nothing
+            "ok": bool(identical and healed and failovers >= 1),
+            "streams": n_streams,
+            "tokens_per_stream": max_new,
+            "token_identical": bool(identical),
+            "streams_complete": bool(complete),
+            "failovers": failovers,
+            "pool_healed": bool(healed),
+            "errors": errors[:3],
+        }
+    finally:
+        if dep is not None:
+            dep.close()
+        raydp_tpu.stop_etl()
+
+
 QUICK = (
     scenario_mid_shuffle,
     scenario_mid_fit,
@@ -831,6 +977,7 @@ QUICK = (
     scenario_service_kill_lineage_fallback,
     scenario_tenant_kill_isolation,
     scenario_replica_kill_during_load,
+    scenario_replica_kill_during_decode,
 )
 FULL = (
     scenario_mid_shuffle,
@@ -841,6 +988,7 @@ FULL = (
     scenario_tenant_kill_isolation,
     scenario_elasticity,
     scenario_replica_kill_during_load,
+    scenario_replica_kill_during_decode,
 )
 
 
@@ -914,7 +1062,8 @@ def main(argv=None) -> int:
     parser.add_argument("--quick", action="store_true",
                         help="CI slice: mid-shuffle + mid-fit lineage kills, "
                              "both block-service tiers, and the serving "
-                             "replica kill")
+                             "replica kills (mid-request-stream and "
+                             "mid-decode-stream)")
     parser.add_argument("--seed", type=int, default=None,
                         help="deterministic victim/timing selection "
                              "(unseeded keeps the fixed legacy choices)")

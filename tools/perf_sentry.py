@@ -69,6 +69,12 @@ STATS: Dict[str, Tuple[str, str]] = {
     "dlrm_train_vs_pure": ("detail.dlrm.train_vs_pure", "higher"),
     "serve_p99_ms": ("detail.serving_probe.p99_ms", "lower"),
     "serve_rps": ("detail.serving_probe.sustained_rps", "higher"),
+    "decode_tokens_per_sec": (
+        "detail.decode_serving_probe.decode_tokens_per_sec", "higher"
+    ),
+    "decode_token_p99_ms": (
+        "detail.decode_serving_probe.token_p99_ms", "lower"
+    ),
     "tenant_p99_ratio": ("detail.tenant_isolation_probe.p99_ratio", "lower"),
     "lm_mfu": ("detail.lm.mfu", "higher"),
     "fit_mfu": ("detail.fit_profile_probe.mfu_live", "higher"),

@@ -185,6 +185,7 @@ def main() -> int:
         "streaming_ingest_probe": detail.get("streaming_ingest_probe", {}),
         "recovery_probe": detail.get("recovery_probe", {}),
         "serving_probe": detail.get("serving_probe", {}),
+        "decode_serving_probe": detail.get("decode_serving_probe", {}),
         "tenant_isolation_probe": detail.get("tenant_isolation_probe", {}),
         "obs_overhead_probe": detail.get("obs_overhead_probe", {}),
         "recovery_overhead": detail.get("recovery_overhead"),
@@ -279,6 +280,47 @@ def main() -> int:
             )
     else:
         failures.append("serving_probe missing from bench detail")
+    decode = artifact["decode_serving_probe"]
+    if decode:
+        parity = decode.get("kernel_parity", {})
+        if not parity.get("ok"):
+            failures.append(
+                f"decode kernel parity failed: {parity} (the one-pass "
+                "body and the decode step must stay BIT-identical to "
+                "their references — any drift breaks the failover "
+                "re-prefill determinism contract)"
+            )
+        token_p99 = decode.get("token_p99_ms")
+        token_slo = decode.get("token_slo_ms")
+        if token_p99 is None or (
+            token_slo is not None and token_p99 > token_slo
+        ):
+            failures.append(
+                f"decode per-token p99 {token_p99}ms exceeds the "
+                f"{token_slo}ms SLO budget (streaming probe: a structural "
+                "decode-loop regression — compile inside the step, "
+                "scheduler stall, poll-path stall)"
+            )
+        tps = decode.get("decode_tokens_per_sec")
+        tps_entry = _sentry_baseline().get("decode_tokens_per_sec")
+        if tps is None or tps <= 0:
+            failures.append(
+                f"decode_tokens_per_sec missing or zero: {decode}"
+            )
+        elif tps_entry and tps_entry.get("value"):
+            floor = float(tps_entry["value"]) * (
+                1.0 - float(tps_entry["band"])
+            )
+            if tps < floor:
+                failures.append(
+                    f"decode_tokens_per_sec {tps:.1f} below the sentry "
+                    f"floor {floor:.1f} (baseline "
+                    f"{tps_entry['value']:.1f} - {tps_entry['band']:.0%})"
+                )
+        if not decode.get("ok"):
+            failures.append(f"decode serving probe failed: {decode}")
+    else:
+        failures.append("decode_serving_probe missing from bench detail")
     tenant = artifact["tenant_isolation_probe"]
     if tenant:
         ratio = tenant.get("p99_ratio")
