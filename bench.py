@@ -690,6 +690,119 @@ def decode_serving_probe() -> dict:
                 pass
 
 
+def decode_obs_overhead_probe() -> dict:
+    """Decode-observatory overhead: per-token cost of stream tracing at
+    sample rate 1.0 (trace minting, prefill + step fan-in span emission)
+    plus the always-on stream bookkeeping, tracing ON vs OFF on one
+    in-process DecodeEngine (perf_smoke gates the quotient).
+
+    In-process by necessity AND by honesty: a driver-side ``set_enabled``
+    cannot reach a deployed replica's process, and the cost under test —
+    the engine loop's per-step instrumentation — is process-local anyway.
+    Interleaved rounds with rotating lead (the r06 lesson), identical
+    sequential stream workload per arm, median-of-round-medians ms/token.
+    A local-ingest stub absorbs flushes for the probe's duration so a
+    missing/stopped head never adds RPC-retry noise to either arm."""
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu.models import TransformerLM
+    from raydp_tpu.obs import tracing as _tracing
+    from raydp_tpu.serve.decode import DecodeEngine
+
+    rounds = int(os.environ.get("BENCH_DECODE_OBS_ROUNDS", 4))
+    streams_per_arm = int(os.environ.get("BENCH_DECODE_OBS_STREAMS", 6))
+    max_new = int(os.environ.get("BENCH_DECODE_OBS_MAX_NEW", 16))
+
+    vocab = 64
+    model = TransformerLM(
+        vocab_size=vocab, d_model=32, num_heads=2, num_layers=2,
+        max_len=256, attn_impl="flash", dtype=jnp.float32,
+    )
+    params = model.init(
+        jax.random.PRNGKey(0), jnp.zeros((1, 8), jnp.int32)
+    )
+    engine = None
+    was_enabled = _tracing.enabled()
+    _tracing.set_local_ingest(lambda **kw: None)
+    try:
+        engine = DecodeEngine(
+            model, params, capacity_tokens=128, page_tokens=32,
+            max_seqs=4, max_new_tokens=max_new,
+            # SLO judging ON in both arms: the deadline accounting is part
+            # of the always-on plane whose cost this probe bounds
+            ttft_slo_ms=1000.0, tpot_slo_ms=1000.0,
+        )
+        rng = np.random.default_rng(23)
+        prompts = [
+            [int(t) for t in rng.integers(0, vocab, 8)] for _ in range(8)
+        ]
+
+        def one_stream(idx: int, ctx) -> float:
+            """Submit + drain one stream; returns ms per emitted token."""
+            t0 = time.perf_counter()
+            sid = engine.submit(
+                prompts[idx % len(prompts)], max_new, trace_ctx=ctx
+            )
+            tokens: list = []
+            deadline = time.monotonic() + 120.0
+            while True:
+                res = engine.poll(sid, len(tokens))
+                tokens.extend(res["tokens"])
+                if res["error"]:
+                    raise RuntimeError(res["error"])
+                if res["done"]:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(f"stream {sid} timed out")
+                time.sleep(0.001)
+            return (time.perf_counter() - t0) * 1000.0 / max(1, len(tokens))
+
+        # warm the prefill + decode-step jits outside the measured rounds
+        for k in range(2):
+            one_stream(k, None)
+
+        def one_arm(arm_on: bool, base: int) -> float:
+            _tracing.set_enabled(arm_on)
+            samples = []
+            for k in range(max(1, streams_per_arm)):
+                ctx = _tracing.mint_context() if arm_on else None
+                samples.append(one_stream(base + k, ctx))
+            samples.sort()
+            return samples[len(samples) // 2]
+
+        ms_on, ms_off = [], []
+        for i in range(max(1, rounds)):
+            order = ((True, False), (False, True))[i % 2]  # rotating lead
+            for arm_on in order:
+                p50 = one_arm(arm_on, i * streams_per_arm)
+                (ms_on if arm_on else ms_off).append(p50)
+        ms_on.sort()
+        ms_off.sort()
+        on_ms = ms_on[len(ms_on) // 2]
+        off_ms = ms_off[len(ms_off) // 2]
+        return {
+            "rounds": rounds,
+            "streams_per_arm": streams_per_arm,
+            "token_ms_on": round(on_ms, 3),
+            "token_ms_off": round(off_ms, 3),
+            "token_ms_on_samples": [round(v, 3) for v in ms_on],
+            "token_ms_off_samples": [round(v, 3) for v in ms_off],
+            "overhead_frac": round(on_ms / max(1e-9, off_ms) - 1.0, 4),
+            "ok": True,
+        }
+    except Exception as exc:  # the bench must report, not crash
+        return {"ok": False, "error": repr(exc)[:300]}
+    finally:
+        _tracing.set_enabled(was_enabled)
+        _tracing.set_local_ingest(None)
+        if engine is not None:
+            try:
+                engine.close()
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (probe teardown best-effort)
+                pass
+
+
 def interactive_burst(session, df, n_queries: int) -> dict:
     """p50/p99 latency of ``n_queries`` repeated identical-shape queries on
     a live session — the interactive workload of ROADMAP item 1. One warm-up
@@ -1935,6 +2048,11 @@ def main():
     # request/response serving probe, after all training clocks
     decode_serving = decode_serving_probe()
 
+    # decode-observatory overhead probe: stream-tracing + SLO-accounting
+    # cost per decoded token, tracing on (sample rate 1.0) vs off on an
+    # in-process engine, interleaved medians — perf_smoke gates it at ≤5%
+    decode_obs = decode_obs_overhead_probe()
+
     # multi-tenant probe (raydp_tpu.tenancy): interactive burst p50/p99
     # solo vs under a co-tenant's heavy shuffle, plus cross-tenant
     # plan-cache evidence — self-contained sessions on the same cluster,
@@ -1988,6 +2106,7 @@ def main():
             "obs_metrics": obs_headline,
             "serving_probe": serving,
             "decode_serving_probe": decode_serving,
+            "decode_obs_probe": decode_obs,
             "tenant_isolation_probe": tenant_probe,
             "obs_overhead_probe": obs_probe,
             "fit_profile_probe": fit_probe,

@@ -105,6 +105,30 @@ def lm_nonattn_flops_per_step(batch: int, seq: int, d_model: int,
     )
 
 
+def lm_decode_flops_per_token(d_model: int, num_layers: int, vocab: int,
+                              context: int) -> int:
+    """Analytic matmul FLOPs to decode ONE token with ``context`` tokens of
+    KV behind it (forward only — serving runs no backward): per layer
+    24*d^2 dense matmuls plus 4*d*context attention (QK^T and AV each read
+    the full cache), plus the d*V lm_head. The capacity planner's per-token
+    roofline arm (tools/capacity_plan.py)."""
+    per_token = num_layers * (24 * d_model**2 + 4 * d_model * int(context))
+    per_token += 2 * d_model * vocab
+    return int(per_token)
+
+
+def lm_prefill_flops(prompt: int, d_model: int, num_layers: int,
+                     vocab: int) -> int:
+    """Forward-only matmul FLOPs of one prefill pass over ``prompt``
+    tokens: the train accounting's forward third (causal attention at
+    average context (prompt+1)/2) — bounds the TTFT compute floor."""
+    per_token = num_layers * (
+        24 * d_model**2 + 2 * d_model * (int(prompt) + 1)
+    )
+    per_token += 2 * d_model * vocab
+    return int(prompt) * per_token
+
+
 def mlp_train_flops_per_step(batch: int, layer_dims: Sequence[int]) -> int:
     """Analytic matmul FLOPs of one dense-MLP training step: forward is
     2*B*d_in*d_out per layer, backward costs 2x forward (grad wrt inputs

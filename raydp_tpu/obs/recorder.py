@@ -220,6 +220,9 @@ class FlightRecorder:
             "head": head_state or {},
             "known_procs": self.proc_keys(),
         }
+        decode = _decode_sections(rings)
+        if decode:
+            dossier["decode"] = decode
         if sanitize.lockdep_enabled():
             dossier["lock_order_graph"] = [
                 list(edge) for edge in sanitize.lock_order_edges()
@@ -268,6 +271,41 @@ class FlightRecorder:
                 "crash dossier write failed", exc_info=True, dir=out_dir
             )
             return None
+
+
+def _decode_sections(rings: List[dict]) -> List[dict]:
+    """Lift each victim ring's newest decode-engine state note (the ~1/s
+    ``serve.decode.state`` log the engine loop emits: in-flight streams with
+    tokens emitted + KV lengths, queue depth, page-table summary) plus the
+    latest ``serve.decode.*`` / ``serve.kv.*`` gauges from its metrics tail
+    into a top-level ``decode`` dossier section — the first thing to read
+    after a mid-decode replica death. Empty list when no ring ever decoded
+    (the dossier then omits the section entirely)."""
+    sections: List[dict] = []
+    for ring in rings:
+        state = None
+        for record in reversed(ring.get("logs") or []):
+            if record.get("message") == "serve.decode.state":
+                state = {
+                    "ts": record.get("ts"),
+                    "fields": record.get("fields") or {},
+                }
+                break
+        gauges: Dict[str, Any] = {}
+        tail = ring.get("metrics_tail") or []
+        if tail:
+            newest = tail[-1].get("metrics") or {}
+            for name, snap in newest.items():
+                if name.startswith(("serve.decode.", "serve.kv.")):
+                    gauges[name] = snap
+        if state is not None or gauges:
+            sections.append({
+                "proc": ring.get("proc"),
+                "role": ring.get("role"),
+                "state": state,
+                "metrics": gauges,
+            })
+    return sections
 
 
 def _slug(text: str) -> str:

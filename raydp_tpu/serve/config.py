@@ -78,6 +78,13 @@ class ServeConf:
     decode_max_new_tokens: int = 64  # per-request generation cap
     decode_int8_kv: bool = False  # int8 K/V pages + in-kernel dequant
     decode_eos_token: Optional[int] = None  # early-stop token id
+    # per-token deadline SLOs (docs/observability.md, "Decode observatory"):
+    # set either and every emitted token is judged against its deadline —
+    # first token vs TTFT, token k vs t_first + (k-1)*TPOT — feeding the
+    # serve.decode.goodput gauge + good/late token counters. None = no
+    # deadline accounting (the default; goodput stays unreported).
+    decode_ttft_slo_ms: Optional[float] = None
+    decode_tpot_slo_ms: Optional[float] = None
     # -- request-path tracing (docs/observability.md) -------------------
     # fraction of requests that mint a trace context and emit the sampled
     # serve.request / serve.batch / replica span chain (only when tracing
@@ -146,6 +153,14 @@ class ServeConf:
             decode_eos_token=(
                 int(get("decode.eos_token"))
                 if get("decode.eos_token") is not None else None
+            ),
+            decode_ttft_slo_ms=(
+                float(get("decode.ttft_slo_ms"))
+                if get("decode.ttft_slo_ms") is not None else None
+            ),
+            decode_tpot_slo_ms=(
+                float(get("decode.tpot_slo_ms"))
+                if get("decode.tpot_slo_ms") is not None else None
             ),
             replica_light=_flag(get("replica_light"), True),
             replica_max_concurrency=max(

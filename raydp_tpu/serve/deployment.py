@@ -66,6 +66,10 @@ class Deployment:
         self._m_reloads = obs.metrics.counter("serve.reloads")
         self._m_failovers = obs.metrics.counter("serve.replica_replacements")
         self._g_replicas = obs.metrics.gauge("serve.replicas")
+        # client-side record of the last COMPLETED decode stream (stamps,
+        # serving replica, stream id) — explain_last_stream starts here
+        # (guarded-by: self._lock)
+        self._last_stream: Optional[dict] = None
         admission = None
         if conf.tenant:
             # ride the named tenant's fair-share queue (docs/multitenancy.md);
@@ -262,66 +266,176 @@ class Deployment:
         parity contract, f32 cache), the continuation carries on with
         exactly the tokens the dead replica would have produced. No token
         is ever emitted twice and none is lost: zero-drop re-admission,
-        stream edition."""
+        stream edition.
+
+        Sampled streams (``obs.request_sample_rate``, tracing on) mint ONE
+        trace id at admission that survives failover: a ``serve.stream``
+        root span here, the engine's prefill child + per-round
+        ``serve.decode.step`` fan-in spans on whichever replica serves each
+        segment, and a ``serve.stream.failover`` span per re-prefill — one
+        trace across driver/head/replica (docs/observability.md)."""
+        import random
         import time
 
+        from raydp_tpu.obs import tracing as _tracing
         from raydp_tpu.serve.batcher import _RETRYABLE
 
         prompt = [int(t) for t in prompt_tokens]
         max_new = int(max_new_tokens)
         emitted: List[int] = []
-        deadline = time.monotonic() + timeout
+        t_request = time.monotonic()
+        deadline = t_request + timeout
         failovers = 0
         rpc_timeout = self._conf.request_timeout_s
-        while True:
-            try:
-                handle = self._pick_decode_handle()
-                sid = handle.decode_submit.options(
-                    timeout=rpc_timeout
-                ).remote(prompt + emitted, max_new - len(emitted)).result()
-                cursor = 0
-                while True:
-                    res = handle.decode_poll.options(
-                        timeout=rpc_timeout
-                    ).remote(sid, cursor).result()
-                    new = res["tokens"]
-                    cursor += len(new)
-                    for tok in new:
-                        emitted.append(int(tok))
-                        yield int(tok)
-                    if res["error"]:
-                        # engine-side failure (e.g. retired by a reload
-                        # mid-stream): same recovery as a dead replica
-                        raise ClusterError(res["error"])
-                    if res["done"]:
-                        return
-                    if time.monotonic() > deadline:
+        ctx = None
+        if (
+            _tracing.enabled()
+            and self._conf.request_sample_rate > 0
+            and random.random() < self._conf.request_sample_rate
+        ):
+            ctx = _tracing.mint_context()
+        handle = None
+        sid = None
+        t_first = None
+        error = None
+        try:
+            while True:
+                try:
+                    handle = self._pick_decode_handle()
+                    # the submit RPC runs under the stream's context, so
+                    # the head's actor-lookup span and the replica's RPC
+                    # hop land in the same trace
+                    with _tracing.use_context(ctx):
+                        sid = handle.decode_submit.options(
+                            timeout=rpc_timeout
+                        ).remote(
+                            prompt + emitted, max_new - len(emitted),
+                            trace_ctx=ctx,
+                        ).result()
+                    cursor = 0
+                    while True:
+                        res = handle.decode_poll.options(
+                            timeout=rpc_timeout
+                        ).remote(sid, cursor).result()
+                        new = res["tokens"]
+                        cursor += len(new)
+                        for tok in new:
+                            if t_first is None:
+                                t_first = time.monotonic()
+                            emitted.append(int(tok))
+                            yield int(tok)
+                        if res["error"]:
+                            # engine-side failure (e.g. retired by a reload
+                            # mid-stream): same recovery as a dead replica
+                            raise ClusterError(res["error"])
+                        if res["done"]:
+                            return
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                f"decode stream timed out after {timeout}s "
+                                f"({len(emitted)}/{max_new} tokens)"
+                            )
+                        time.sleep(0.003)
+                except _RETRYABLE + (KeyError,):
+                    failovers += 1
+                    t_fail = time.monotonic()
+                    if failovers > self._conf.max_retries:
+                        raise
+                    if t_fail > deadline:
                         raise TimeoutError(
                             f"decode stream timed out after {timeout}s "
                             f"({len(emitted)}/{max_new} tokens)"
                         )
-                    time.sleep(0.003)
-            except _RETRYABLE + (KeyError,):
-                failovers += 1
-                if failovers > self._conf.max_retries:
-                    raise
-                if time.monotonic() > deadline:
-                    raise TimeoutError(
-                        f"decode stream timed out after {timeout}s "
-                        f"({len(emitted)}/{max_new} tokens)"
+                    obs.log.warning(
+                        "decode stream failover: re-prefilling on a survivor",
+                        deployment=self._name, emitted=len(emitted),
+                        exc_info=True,
                     )
-                obs.log.warning(
-                    "decode stream failover: re-prefilling on a survivor",
-                    deployment=self._name, emitted=len(emitted),
-                    exc_info=True,
+                    obs.metrics.counter("serve.decode.failovers").inc()
+                    self.heal()
+                    if ctx is not None and _tracing.enabled():
+                        heal_s = time.monotonic() - t_fail
+                        _tracing.record_span(
+                            "serve.stream.failover",
+                            time.time_ns() // 1000 - int(heal_s * 1e6),
+                            int(heal_s * 1e6),
+                            trace=ctx[0], parent=ctx[1],
+                            emitted=len(emitted), failovers=failovers,
+                            deployment=self._name,
+                        )
+        except BaseException as exc:
+            error = repr(exc)[:200]
+            raise
+        finally:
+            t_done = time.monotonic()
+            record = {
+                "deployment": self._name,
+                "handle": handle,
+                "stream_id": sid,
+                "tokens": len(emitted),
+                "failovers": failovers,
+                "error": error,
+                "wall_s": max(0.0, t_done - t_request),
+                "ttft_s": (
+                    max(0.0, t_first - t_request)
+                    if t_first is not None else None
+                ),
+                "trace": ctx[0] if ctx else None,
+            }
+            with self._lock:
+                self._last_stream = record
+            if ctx is not None and _tracing.enabled():
+                _tracing.record_span(
+                    "serve.stream",
+                    time.time_ns() // 1000 - int(record["wall_s"] * 1e6),
+                    int(record["wall_s"] * 1e6),
+                    trace=ctx[0], span_id=ctx[1], parent=None,
+                    deployment=self._name, tokens=len(emitted),
+                    failovers=failovers, error=error,
+                    ttft_ms=(
+                        round(record["ttft_s"] * 1000.0, 3)
+                        if record["ttft_s"] is not None else None
+                    ),
                 )
-                obs.metrics.counter("serve.decode.failovers").inc()
-                self.heal()
 
     def generate(self, prompt_tokens, max_new_tokens: int,
                  timeout: float = 120.0) -> List[int]:
         """Blocking convenience over ``stream``: the full token list."""
         return list(self.stream(prompt_tokens, max_new_tokens, timeout))
+
+    def explain_last_stream(self, top_k: int = 5) -> dict:
+        """Decompose the last completed stream's wall time: TTFT into
+        queue wait / KV alloc / prefill compute / dispatch, and the steady
+        state into step compute / admission churn / batch-fill stall —
+        from the serving engine's own stream record plus this client's
+        stamps. Works with tracing OFF, exactly like ``explain_last_query``
+        / ``explain_last_fit``; returns the ``obs.analysis.explain_stream``
+        report with a rendered ``text`` field."""
+        with self._lock:
+            record = dict(self._last_stream) if self._last_stream else None
+        if record is None:
+            raise RuntimeError(
+                "no stream has completed on this deployment yet"
+            )
+        engine_record = None
+        handle = record.get("handle")
+        if handle is not None and record.get("stream_id"):
+            try:
+                engine_record = handle.decode_explain.options(
+                    timeout=self._conf.request_timeout_s
+                ).remote(record["stream_id"]).result()
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (the serving replica may have died since; the client stamps still attribute what they can)
+                engine_record = None
+        from raydp_tpu.obs.analysis import explain_stream
+
+        return explain_stream(record, engine_record, top_k=top_k)
+
+    def decode_stats(self) -> List[dict]:
+        """Per-replica decode engine stats (inflight/queued/KV/goodput/veto
+        causes) — empty dicts for replicas that never streamed."""
+        with self._lock:
+            snapshot = list(self._handles)
+        return [h.decode_stats.remote().result() for h in snapshot]
 
     # -- lifecycle ------------------------------------------------------
 
@@ -435,6 +549,9 @@ def deploy(
             "int8_kv": resolved.decode_int8_kv,
             "eos_token": resolved.decode_eos_token,
             "max_mem_pressure": resolved.max_mem_pressure,
+            "ttft_slo_ms": resolved.decode_ttft_slo_ms,
+            "tpot_slo_ms": resolved.decode_tpot_slo_ms,
+            "tenant": resolved.tenant,
         }
     spec = ReplicaSpec(
         model=model,

@@ -57,6 +57,7 @@ class PagedKVCache:
         pool_pages: int | None = None,
         int8: bool = False,
         storage: str = "shm",
+        tenant: str = "",
     ):
         if capacity_tokens % page_tokens:
             raise ValueError(
@@ -71,6 +72,7 @@ class PagedKVCache:
         self.pages_per_seq = capacity_tokens // page_tokens
         self.pool_pages = pool_pages or max_seqs * self.pages_per_seq
         self.int8 = int8
+        self.tenant = str(tenant or "")
 
         page_rows = page_tokens * heads
         val_itemsize = 1 if int8 else 4
@@ -134,6 +136,32 @@ class PagedKVCache:
     def _update_gauges(self) -> None:
         metrics.gauge("serve.kv.pages_free").set(float(len(self._free)))
         metrics.gauge("serve.kv.seqs").set(float(len(self._tables)))
+        # occupancy/fragmentation plane (docs/observability.md, decode/KV
+        # table): occupancy is the page pool's fill; fragmentation is the
+        # share of allocated token slots no live position occupies (pages
+        # are fixed-size, so a 1-token tail page is mostly waste) — the
+        # "why is the pool full at low token counts" signal
+        used = self.pool_pages - len(self._free)
+        metrics.gauge("serve.kv.page_occupancy").set(
+            used / float(self.pool_pages) if self.pool_pages else 0.0
+        )
+        allocated_tokens = used * self.page_tokens
+        live_tokens = sum(self._lengths.values())
+        metrics.gauge("serve.kv.fragmentation").set(
+            1.0 - live_tokens / float(allocated_tokens)
+            if allocated_tokens else 0.0
+        )
+        used_bytes = (
+            self.nbytes * (used / float(self.pool_pages))
+            if self.pool_pages else 0.0
+        )
+        metrics.gauge("serve.kv.used_bytes").set(used_bytes)
+        if self.tenant:
+            # tenant.<ns>.* names become tenant-labeled TSDB series
+            # (obs/timeseries.py split_labels) — per-tenant KV accounting
+            metrics.gauge(f"tenant.{self.tenant}.serve.kv.bytes").set(
+                used_bytes
+            )
 
     def alloc(self, seq_id: str) -> None:
         with self._lock:
@@ -277,8 +305,16 @@ class PagedKVCache:
         except BufferError:  # raydp-lint: disable=swallowed-exceptions (a live numpy view pins the mmap; unlink still frees the name)
             pass
         metrics.gauge("serve.kv.bytes").set(0.0)
+        # pages_total too (ISSUE 17 satellite): a closed arena must not
+        # keep advertising capacity to scrapes
+        metrics.gauge("serve.kv.pages_total").set(0.0)
         metrics.gauge("serve.kv.pages_free").set(0.0)
         metrics.gauge("serve.kv.seqs").set(0.0)
+        metrics.gauge("serve.kv.page_occupancy").set(0.0)
+        metrics.gauge("serve.kv.fragmentation").set(0.0)
+        metrics.gauge("serve.kv.used_bytes").set(0.0)
+        if self.tenant:
+            metrics.gauge(f"tenant.{self.tenant}.serve.kv.bytes").set(0.0)
 
     def __enter__(self):
         return self

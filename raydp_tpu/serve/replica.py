@@ -279,12 +279,16 @@ class ModelReplica:
             return self._decode
 
     def decode_submit(
-        self, prompt_tokens, max_new_tokens: int, stream_id=None
+        self, prompt_tokens, max_new_tokens: int, stream_id=None,
+        trace_ctx=None,
     ) -> str:
         """Queue an autoregressive generation on this replica's
-        continuous-batching engine; returns the stream id to poll."""
+        continuous-batching engine; returns the stream id to poll.
+        ``trace_ctx`` is a sampled stream's (trace_id, root_span_id) —
+        the engine's prefill + step fan-in spans parent under it, the
+        replica-side hop of one stream trace."""
         return self._decode_engine().submit(
-            prompt_tokens, max_new_tokens, stream_id
+            prompt_tokens, max_new_tokens, stream_id, trace_ctx=trace_ctx
         )
 
     def decode_poll(self, stream_id: str, cursor: int = 0) -> dict:
@@ -294,6 +298,14 @@ class ModelReplica:
     def decode_stats(self) -> dict:
         engine = self._decode
         return engine.stats() if engine is not None else {}
+
+    def decode_explain(self, stream_id=None):
+        """The engine-kept timing record for one retired stream (newest by
+        default) — fetched by ``deployment.explain_last_stream()``; works
+        with tracing off. None when the engine never ran or the record
+        aged out."""
+        engine = self._decode
+        return engine.explain(stream_id) if engine is not None else None
 
     def warm(self, example) -> int:
         """Precompile every configured bucket for ``example``'s row shape;

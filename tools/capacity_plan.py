@@ -1,0 +1,360 @@
+"""Decode capacity planner: replicas needed for a target token throughput
+at a TTFT/TPOT SLO, planned over the evidence the repo already carries.
+
+Three input planes, strongest-available wins per number:
+
+- the **sentry ledger** (``BENCH_BASELINE.json``): its
+  ``decode_tokens_per_sec`` baseline came from the committed
+  ``decode_serving_probe`` runs, which deploy :data:`PROBE_REPLICAS`
+  replicas — per-replica throughput is the ledger value divided by that;
+- the newest **bench snapshot**'s ``decode_serving_probe`` detail
+  (``BENCH_r*.json``): observed TTFT and per-token p99 under the probe's
+  closed-loop load — the latency evidence the SLO feasibility flags are
+  judged against;
+- optionally a **live TSDB scrape** (``--scrape host:port``, the
+  ``obs.scrape_port`` Prometheus endpoint): current
+  ``serve.ttft_ms.p99`` / ``serve.tpot_ms.p99`` / ``serve.decode.goodput``
+  series override the snapshot's numbers — plan against what the cluster
+  is doing NOW, not what a past bench measured.
+
+A fourth, analytic arm (``obs/costmodel.py``) reports the compute
+roofline: tokens/sec per device the probe model could at most decode at
+peak FLOP/s — so a plan asking for throughput above ``replicas ×
+roofline`` is flagged infeasible regardless of what the probe measured.
+
+The replica count itself is the honest division::
+
+    replicas = ceil(target_tps / (per_replica_tps * utilization))
+
+with ``utilization`` defaulting to :data:`DEFAULT_UTILIZATION` — the probe
+measures a saturated closed loop; production admission churn and bursty
+arrivals land below that.
+
+``--check`` (the CI gate) verifies the planner against the committed
+ledger: planning for exactly the ledger throughput at utilization 1.0 must
+ask for exactly the probe's replica count, plans must be monotone in the
+target, and SLO feasibility must flag an impossible deadline. Writes the
+plan report JSON (``--out``) and exits non-zero on any violation.
+
+Usage:
+    python -m tools.capacity_plan --target-tps 2000 \
+        --ttft-slo-ms 50 --tpot-slo-ms 20
+    python -m tools.capacity_plan --check --out capacity_plan.json
+"""
+# raydp-lint: disable-file=print-diagnostics (standalone CI tool: its stdout IS the report, there is no obs role to tag)
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import math
+import os
+import re
+import sys
+from typing import Any, Dict, Optional
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DEFAULT_LEDGER = "BENCH_BASELINE.json"
+
+# decode_serving_probe (bench.py) deploys this many replicas — the ledger's
+# decode_tokens_per_sec is the AGGREGATE across them
+PROBE_REPLICAS = 2
+
+# the probe's model geometry (bench.py decode_serving_probe): the roofline
+# arm prices THIS model; a real deployment passes its own via the flags
+PROBE_MODEL = {"d_model": 32, "num_layers": 2, "vocab": 64, "context": 128}
+
+# planned headroom: the probe is a saturated closed loop, production isn't
+DEFAULT_UTILIZATION = 0.7
+
+
+def load_ledger(path: str) -> Dict[str, Any]:
+    with open(path) as f:
+        ledger = json.load(f)
+    return ledger.get("baseline", {})
+
+
+def newest_bench_detail(repo: str = REPO) -> Optional[Dict[str, Any]]:
+    """The newest committed ``BENCH_r*.json``'s ``decode_serving_probe``
+    detail, or None when no snapshot carries one (pre-r16 checkouts)."""
+    paths = glob.glob(os.path.join(repo, "BENCH_r*.json"))
+
+    def release_n(path: str) -> int:
+        match = re.search(r"BENCH_r(\d+)\.json$", path)
+        return int(match.group(1)) if match else -1
+
+    for path in sorted(paths, key=release_n, reverse=True):
+        try:
+            with open(path) as f:
+                snap = json.load(f)
+        except (OSError, ValueError):  # raydp-lint: disable=swallowed-exceptions (an unreadable/corrupt snapshot just falls through to the next-newest release; the ledger itself is the authoritative input)
+            continue
+        detail = (snap.get("parsed") or {}).get("detail") or {}
+        probe = detail.get("decode_serving_probe")
+        if isinstance(probe, dict) and probe.get("tokens"):
+            probe = dict(probe)
+            probe["source"] = os.path.basename(path)
+            return probe
+    return None
+
+
+def scrape_live(addr: str) -> Dict[str, float]:
+    """Current decode-plane series from a live scrape endpoint: every
+    ``serve.decode.*`` / ``serve.ttft_ms.*`` / ``serve.tpot_ms.*`` /
+    ``serve.kv.*`` sample (label-free form), name -> value."""
+    from raydp_tpu.obs.timeseries import parse_prometheus_text, scrape
+
+    host, _, port = addr.rpartition(":")
+    text = scrape(host or "127.0.0.1", int(port))
+    out: Dict[str, float] = {}
+    for name, samples in parse_prometheus_text(text).items():
+        if not name.startswith(
+            ("serve.decode.", "serve.ttft_ms", "serve.tpot_ms", "serve.kv.")
+        ):
+            continue
+        for labels, value in samples.items():
+            if not labels:  # the un-labeled (non-tenant) series
+                out[name] = value
+    return out
+
+
+def roofline(model: Dict[str, int]) -> Dict[str, Any]:
+    """Compute-bound tokens/sec per device for ``model`` at peak FLOP/s —
+    None fields when no device/peak is known (jax-free checkouts)."""
+    from raydp_tpu.obs.costmodel import lm_decode_flops_per_token
+
+    flops_per_token = lm_decode_flops_per_token(
+        model["d_model"], model["num_layers"], model["vocab"],
+        model["context"],
+    )
+    info: Dict[str, Any] = {
+        "flops_per_token": flops_per_token,
+        "tokens_per_sec_bound": None,
+        "peak": None,
+        "peak_source": "unknown",
+    }
+    try:
+        from raydp_tpu.obs.costmodel import device_peak_flops
+
+        peak = device_peak_flops()
+        info["peak"] = peak.get("peak")
+        info["peak_source"] = peak.get("peak_source")
+        if peak.get("peak"):
+            info["tokens_per_sec_bound"] = peak["peak"] / flops_per_token
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (no jax / no device: the roofline arm degrades to unknown, the plan still prices from the ledger)
+        pass
+    return info
+
+
+def plan(target_tps: float, per_replica_tps: float,
+         utilization: float = DEFAULT_UTILIZATION,
+         ttft_slo_ms: Optional[float] = None,
+         tpot_slo_ms: Optional[float] = None,
+         observed_ttft_ms: Optional[float] = None,
+         observed_tpot_p99_ms: Optional[float] = None,
+         roofline_tps: Optional[float] = None) -> Dict[str, Any]:
+    """One plan: the replica count plus SLO/roofline feasibility flags.
+    Feasibility fields are ``None`` (unknown) when either side of the
+    comparison is missing — never a silent pass."""
+    if per_replica_tps <= 0:
+        raise ValueError("per_replica_tps must be positive")
+    if not 0 < utilization <= 1.0:
+        raise ValueError("utilization must be in (0, 1]")
+    effective = per_replica_tps * utilization
+    replicas = max(1, math.ceil(target_tps / effective))
+    ttft_ok = (
+        None if ttft_slo_ms is None or observed_ttft_ms is None
+        else observed_ttft_ms <= ttft_slo_ms
+    )
+    tpot_ok = (
+        None if tpot_slo_ms is None or observed_tpot_p99_ms is None
+        else observed_tpot_p99_ms <= tpot_slo_ms
+    )
+    # the analytic ceiling: asking one replica's device for more tokens/sec
+    # than the model's FLOPs fit at peak cannot be fixed by measuring again
+    compute_ok = (
+        None if roofline_tps is None
+        else per_replica_tps <= roofline_tps * 1.05  # 5% accounting slack
+    )
+    return {
+        "target_tokens_per_sec": target_tps,
+        "per_replica_tokens_per_sec": per_replica_tps,
+        "utilization": utilization,
+        "replicas": replicas,
+        "planned_tokens_per_sec": replicas * effective,
+        "ttft_slo_ms": ttft_slo_ms,
+        "observed_ttft_ms": observed_ttft_ms,
+        "ttft_feasible": ttft_ok,
+        "tpot_slo_ms": tpot_slo_ms,
+        "observed_tpot_p99_ms": observed_tpot_p99_ms,
+        "tpot_feasible": tpot_ok,
+        "roofline_tokens_per_sec": roofline_tps,
+        "throughput_compute_feasible": compute_ok,
+        "feasible": ttft_ok is not False and tpot_ok is not False
+        and compute_ok is not False,
+    }
+
+
+def build_report(args: argparse.Namespace) -> Dict[str, Any]:
+    baseline = load_ledger(args.ledger)
+    decode_stat = baseline.get("decode_tokens_per_sec") or {}
+    ledger_tps = float(decode_stat.get("value") or 0.0)
+    if ledger_tps <= 0:
+        raise SystemExit(
+            f"ledger {args.ledger} has no decode_tokens_per_sec baseline "
+            "(run bench.py + tools/perf_sentry --write first)"
+        )
+    per_replica = ledger_tps / PROBE_REPLICAS
+
+    probe = newest_bench_detail()
+    observed_ttft = probe.get("ttft_ms") if probe else None
+    observed_tpot = probe.get("token_p99_ms") if probe else None
+
+    live: Dict[str, float] = {}
+    if args.scrape:
+        live = scrape_live(args.scrape)
+        observed_ttft = live.get("serve.ttft_ms.p99", observed_ttft)
+        observed_tpot = live.get("serve.tpot_ms.p99", observed_tpot)
+
+    roof = roofline(PROBE_MODEL)
+    report = {
+        "format": "raydp-capacity-plan-v1",
+        "ledger": {
+            "path": os.path.basename(args.ledger),
+            "decode_tokens_per_sec": ledger_tps,
+            "probe_replicas": PROBE_REPLICAS,
+            "per_replica_tokens_per_sec": per_replica,
+        },
+        "bench_probe": probe,
+        "live": live or None,
+        "roofline": roof,
+        "plan": plan(
+            args.target_tps if args.target_tps is not None else ledger_tps,
+            per_replica,
+            utilization=args.utilization,
+            ttft_slo_ms=args.ttft_slo_ms,
+            tpot_slo_ms=args.tpot_slo_ms,
+            observed_ttft_ms=observed_ttft,
+            observed_tpot_p99_ms=observed_tpot,
+            roofline_tps=roof.get("tokens_per_sec_bound"),
+        ),
+    }
+    return report
+
+
+def run_check(args: argparse.Namespace) -> int:
+    """The CI self-check: the planner against its own ledger."""
+    report = build_report(args)
+    ledger_tps = report["ledger"]["decode_tokens_per_sec"]
+    per_replica = report["ledger"]["per_replica_tokens_per_sec"]
+    probe = report["bench_probe"] or {}
+    failures = []
+
+    # planning for exactly what the probe measured, at the probe's own
+    # (saturated) utilization, must ask for exactly the probe's replicas
+    identity = plan(ledger_tps, per_replica, utilization=1.0)
+    if identity["replicas"] != PROBE_REPLICAS:
+        failures.append(
+            f"identity plan asked for {identity['replicas']} replicas, "
+            f"probe ran {PROBE_REPLICAS}"
+        )
+
+    # monotone in the target: more tokens never fewer replicas
+    ladder = [
+        plan(ledger_tps * mult, per_replica,
+             utilization=args.utilization)["replicas"]
+        for mult in (0.25, 0.5, 1.0, 2.0, 4.0, 8.0)
+    ]
+    if ladder != sorted(ladder):
+        failures.append(f"replica ladder not monotone: {ladder}")
+
+    # SLO feasibility must actually flag: an impossible per-token deadline
+    # (tighter than anything ever measured) must come back infeasible, a
+    # generous one feasible — judged against the committed probe evidence
+    observed_tpot = probe.get("token_p99_ms")
+    if observed_tpot:
+        tight = plan(ledger_tps, per_replica, tpot_slo_ms=0.001,
+                     observed_tpot_p99_ms=observed_tpot)
+        loose = plan(ledger_tps, per_replica,
+                     tpot_slo_ms=observed_tpot * 100,
+                     observed_tpot_p99_ms=observed_tpot)
+        if tight["tpot_feasible"] is not False or tight["feasible"]:
+            failures.append("impossible TPOT SLO not flagged infeasible")
+        if loose["tpot_feasible"] is not True:
+            failures.append("generous TPOT SLO not flagged feasible")
+    else:
+        failures.append(
+            "no committed decode_serving_probe detail (BENCH_r*.json) — "
+            "SLO feasibility has no evidence to judge against"
+        )
+
+    report["check"] = {"ok": not failures, "failures": failures}
+    _write_report(report, args.out)
+    print(json.dumps(report["check"], indent=1))
+    return 0 if not failures else 1
+
+
+def _write_report(report: Dict[str, Any], out: Optional[str]) -> None:
+    if not out:
+        return
+    with open(out, "w") as f:
+        json.dump(report, f, indent=1, default=str)
+    print(f"wrote {out}")
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--target-tps", type=float, default=None,
+                        help="target aggregate tokens/sec "
+                        "(default: the ledger baseline)")
+    parser.add_argument("--ttft-slo-ms", type=float, default=None)
+    parser.add_argument("--tpot-slo-ms", type=float, default=None)
+    parser.add_argument("--utilization", type=float,
+                        default=DEFAULT_UTILIZATION,
+                        help="planned per-replica utilization (0, 1]")
+    parser.add_argument("--ledger",
+                        default=os.path.join(REPO, DEFAULT_LEDGER))
+    parser.add_argument("--scrape", default=None, metavar="HOST:PORT",
+                        help="live TSDB scrape endpoint; overrides the "
+                        "bench snapshot's observed TTFT/TPOT")
+    parser.add_argument("--out", default=None,
+                        help="write the full plan report JSON here")
+    parser.add_argument("--check", action="store_true",
+                        help="CI self-check against the committed ledger")
+    args = parser.parse_args(argv)
+
+    if args.check:
+        return run_check(args)
+    report = build_report(args)
+    _write_report(report, args.out)
+    p = report["plan"]
+    print(
+        f"target {p['target_tokens_per_sec']:.1f} tok/s at "
+        f"{p['utilization']:.0%} utilization -> {p['replicas']} replicas "
+        f"({p['per_replica_tokens_per_sec']:.1f} tok/s each, plans to "
+        f"{p['planned_tokens_per_sec']:.1f})"
+    )
+    for side in ("ttft", "tpot"):
+        slo = p[f"{side}_slo_ms"]
+        if slo is None:
+            continue
+        observed = p[f"observed_{side}_ms" if side == "ttft"
+                     else "observed_tpot_p99_ms"]
+        verdict = p[f"{side}_feasible"]
+        print(
+            f"{side} SLO {slo:.2f} ms vs observed "
+            f"{observed if observed is not None else '?'} ms: "
+            f"{'ok' if verdict else 'INFEASIBLE' if verdict is False else 'unknown'}"
+        )
+    if p["throughput_compute_feasible"] is False:
+        print(
+            f"INFEASIBLE: per-replica demand exceeds the compute roofline "
+            f"({p['roofline_tokens_per_sec']:.1f} tok/s/device)"
+        )
+    return 0 if p["feasible"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

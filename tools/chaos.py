@@ -870,6 +870,7 @@ def scenario_replica_kill_during_decode(
 
     session = _fresh_session("chaos-decode")
     dep = None
+    scenario_t0 = time.time()
     try:
         dep = serve.deploy(
             model=model, checkpoint_dir=ckpt_dir, replicas=2,
@@ -951,17 +952,46 @@ def scenario_replica_kill_during_decode(
         while dep.replica_count() < target and time.monotonic() < deadline:
             time.sleep(0.05)
         healed = dep.replica_count() == target
+        # crash-dossier decode section (obs/recorder.py): the SIGKILL made
+        # the head write a dossier for the victim, and it must carry the
+        # decode observatory's section — the victim's last in-flight-stream
+        # state note and/or its serve.decode.*/serve.kv.* gauges. Gated
+        # only when a dossier dir is configured (the chaos runner always
+        # sets one); the write is async with the death event, so poll.
+        dossier_dir = os.environ.get("RAYDP_TPU_DOSSIER_DIR", "")
+        dossier_decode = None
+        if dossier_dir:
+            from raydp_tpu.obs.recorder import list_dossiers
+
+            dossier_decode = False
+            poll_deadline = time.monotonic() + 10.0
+            while not dossier_decode and time.monotonic() < poll_deadline:
+                for path in reversed(list_dossiers(dossier_dir)):
+                    try:
+                        with open(path) as f:
+                            doc = json.load(f)
+                    except (OSError, ValueError):  # raydp-lint: disable=swallowed-exceptions (a dossier mid-write by the head is retried on the next poll tick; the 10s deadline turns persistent unreadability into a gate failure)
+                        continue
+                    if float(doc.get("ts") or 0) < scenario_t0:
+                        continue
+                    if doc.get("decode"):
+                        dossier_decode = True
+                        break
+                else:
+                    time.sleep(0.25)
         return {
             "name": "replica_kill_during_decode",
             # failovers >= 1: the kill provably interrupted live streams —
             # token identity with zero failovers would gate nothing
-            "ok": bool(identical and healed and failovers >= 1),
+            "ok": bool(identical and healed and failovers >= 1
+                       and dossier_decode is not False),
             "streams": n_streams,
             "tokens_per_stream": max_new,
             "token_identical": bool(identical),
             "streams_complete": bool(complete),
             "failovers": failovers,
             "pool_healed": bool(healed),
+            "dossier_decode_section": dossier_decode,
             "errors": errors[:3],
         }
     finally:

@@ -186,6 +186,7 @@ def main() -> int:
         "recovery_probe": detail.get("recovery_probe", {}),
         "serving_probe": detail.get("serving_probe", {}),
         "decode_serving_probe": detail.get("decode_serving_probe", {}),
+        "decode_obs_probe": detail.get("decode_obs_probe", {}),
         "tenant_isolation_probe": detail.get("tenant_isolation_probe", {}),
         "obs_overhead_probe": detail.get("obs_overhead_probe", {}),
         "recovery_overhead": detail.get("recovery_overhead"),
@@ -321,6 +322,29 @@ def main() -> int:
             failures.append(f"decode serving probe failed: {decode}")
     else:
         failures.append("decode_serving_probe missing from bench detail")
+    decode_obs = artifact["decode_obs_probe"]
+    if decode_obs:
+        if not decode_obs.get("ok"):
+            failures.append(f"decode obs overhead probe failed: {decode_obs}")
+        else:
+            token_on = decode_obs.get("token_ms_on")
+            token_off = decode_obs.get("token_ms_off")
+            if token_on is None or token_off is None:
+                failures.append(
+                    f"decode obs overhead probe incomplete: {decode_obs}"
+                )
+            # same shape as the telemetry/profiler gates: ≤5% on the
+            # per-token p50 with a 0.25 ms quantization floor — stream
+            # tracing at sample rate 1.0 must stay ~free per decoded token
+            elif token_on > token_off * (1.0 + OBS_OVERHEAD_BUDGET) + 0.25:
+                failures.append(
+                    f"decode tracing-on token p50 {token_on:.3f}ms exceeds "
+                    f"tracing-off {token_off:.3f}ms by more than "
+                    f"{OBS_OVERHEAD_BUDGET:.0%} (+0.25ms floor): the decode "
+                    "observatory must stay ~free per decoded token"
+                )
+    else:
+        failures.append("decode_obs_probe missing from bench detail")
     tenant = artifact["tenant_isolation_probe"]
     if tenant:
         ratio = tenant.get("p99_ratio")

@@ -81,6 +81,41 @@ def main() -> None:
     time.sleep(0.2)
     dep.close()
 
+    # decode leg: one streamed generation with EVERY stream sampled — the
+    # stream trace must link the driver (serve.stream root), the head
+    # (actor RPC), and the replica (prefill + step fan-in spans) under one
+    # trace id; a second stream after the replica's flush throttle window
+    # ships the first stream's engine spans (same discipline as above)
+    import jax
+    import jax.numpy as jnp
+
+    from raydp_tpu.models import TransformerLM
+
+    lm_vocab = 32
+    lm = TransformerLM(
+        vocab_size=lm_vocab, d_model=32, num_heads=2, num_layers=2,
+        max_len=256, attn_impl="flash", dtype=jnp.float32,
+    )
+    lm_ckpt = tempfile.mkdtemp(prefix="trace-smoke-lm-")
+    lm_est = JaxEstimator(model=lm, checkpoint_dir=lm_ckpt)
+    lm_params = lm.init(jax.random.PRNGKey(0), jnp.zeros((1, 4), jnp.int32))
+    lm_est._save_checkpoint(lm_params, 0, {})
+    dep2 = serve.deploy(
+        model=lm, checkpoint_dir=lm_ckpt, replicas=1,
+        conf={
+            "serve.decode.enabled": True,
+            "serve.decode.capacity_tokens": 64,
+            "serve.decode.page_tokens": 16,
+            "obs.request_sample_rate": 1.0,
+        },
+    )
+    streamed = list(dep2.stream([1, 2, 3], 8, timeout=120))
+    assert streamed, "decode leg streamed no tokens"
+    time.sleep(0.7)
+    list(dep2.stream([2, 3, 4], 4, timeout=120))
+    time.sleep(0.2)
+    dep2.close()
+
     if len(sys.argv) > 1:
         path = sys.argv[1]
     else:
@@ -141,11 +176,48 @@ def main() -> None:
         f"missing serve fan-in spans: {len(batch_spans)} batch, "
         f"{len(infer_spans)} replica_infer"
     )
+    # decode stream-path linkage: at least one sampled stream trace whose
+    # spans come from >=3 processes under ONE trace id, carrying the
+    # replica's prefill span and >=1 decode-step fan-in span listing the
+    # streams that rode that batch round
+    stream_spans = [e for e in complete if e["name"] == "serve.stream"]
+    assert stream_spans, "no sampled serve.stream spans in trace"
+    stream_trace = None
+    stream_procs: set = set()
+    for event in stream_spans:
+        trace_id = event["args"].get("trace_id")
+        procs_in_trace = {
+            track_proc.get(e["pid"], str(e["pid"]))
+            for e in complete if e["args"].get("trace_id") == trace_id
+        }
+        if len(procs_in_trace) > len(stream_procs):
+            stream_procs, stream_trace = procs_in_trace, trace_id
+    assert len(stream_procs) >= 3, (
+        f"decode stream trace spans only {stream_procs} — expected >=3 "
+        "processes (driver, head, replica) under one trace id"
+    )
+    prefill_spans = [
+        e for e in complete if e["name"] == "serve.decode.prefill"
+        and e["args"].get("trace_id") == stream_trace
+    ]
+    step_spans = [
+        e for e in complete if e["name"] == "serve.decode.step"
+        and e["args"].get("trace_id") == stream_trace
+    ]
+    assert prefill_spans, "no serve.decode.prefill span on the stream trace"
+    assert step_spans and any(
+        e["args"].get("stream_spans") for e in step_spans
+    ), (
+        f"missing decode-step fan-in spans on the stream trace: "
+        f"{len(step_spans)} steps"
+    )
     metrics = raydp_tpu.dump_metrics()
     assert metrics, "dump_metrics returned nothing"
     print(
         f"trace ok: {len(events)} events from {len(procs)} processes, "
         f"serve request trace across {len(best_procs)} processes, "
+        f"decode stream trace across {len(stream_procs)} processes "
+        f"({len(prefill_spans)} prefill + {len(step_spans)} step spans), "
         f"{len(metrics)} metric registries -> {path}"
     )
 
