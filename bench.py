@@ -1174,6 +1174,204 @@ def fit_profile_probe() -> dict:
                 "error": repr(exc)[:300]}
 
 
+def crosshost_shuffle_probe() -> dict:
+    """Cross-host data plane probe (docs/cluster.md "Multi-host topology";
+    perf_smoke gates parity + locality hit rate).
+
+    A node agent with its own shm namespace stands in for a second host
+    (TCP-only reachability between them). Two arms on the same cluster:
+    *cross* spans an executor per host — executor sizing forces the spread
+    from live free head CPU, the tests/test_multihost.py trick — while
+    *single* packs both executors onto one host. Interleaved rounds with
+    rotating lead (the r06 lesson) time the same hash-shuffle groupby on
+    both arms; the gate is byte-identical results plus a deterministic
+    small fit (seeded, streaming) whose final params must match across
+    arms bit-for-bit, with ``rpc.bytes_over_wire`` > 0 proving the wire
+    was actually crossed and ``planner.locality_hits`` rate ≥ 0.8 proving
+    reduce placement followed the bytes."""
+    import statistics
+
+    import jax
+    import numpy as np
+    import pandas as pd
+
+    import raydp_tpu
+    from raydp_tpu import obs, tenancy
+    from raydp_tpu.cluster import api as cluster_api
+    from raydp_tpu.estimator import JaxEstimator
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+    from raydp_tpu.models import MLPRegressor
+
+    rows = int(os.environ.get("BENCH_XHOST_ROWS", 120_000))
+    rounds = int(os.environ.get("BENCH_XHOST_ROUNDS", 3))
+
+    def _wire_totals():
+        merged = cluster_api.dump_metrics()
+
+        def total(name):
+            return sum(
+                snap.get(name, {}).get("value", 0.0)
+                for snap in merged.values()
+            )
+
+        return (
+            total("rpc.bytes_over_wire"),
+            total("rpc.remote_fetches"),
+            total("rpc.doorbell_tcp"),
+        )
+
+    head_node = next(
+        n for n in cluster_api.nodes() if n.agent_addr is None and n.alive
+    )
+    head_free = cluster_api.available_resources()[head_node.node_id].get(
+        "CPU", 0.0
+    )
+    if head_free < 2:
+        return {"ok": False, "note": f"head CPU too small ({head_free})"}
+    # cross executors cannot both fit on the head; single executors cannot
+    # fit in what the cross arm leaves free there, so they pack onto the
+    # (amply sized) simulated host together — each arm's shape is forced,
+    # not hoped for, and verified below
+    cores_x = int(head_free // 2 + 1)
+    cores_s = int(head_free - cores_x + 1)
+    agent_info = cluster_api.start_node_agent(
+        {"CPU": float(cores_x + 2 * cores_s), "memory": float(2 << 30)},
+        shm_ns="xhb",
+    )
+    agent_node_id = agent_info["node_id"]
+    cross = raydp_tpu.init_etl(
+        "bench-xhost", num_executors=2, executor_cores=cores_x,
+        executor_memory="300M",
+    )
+    single = None
+    try:
+        single = raydp_tpu.init_etl(
+            "bench-xhost-single", num_executors=2, executor_cores=cores_s,
+            executor_memory="300M",
+        )
+        spans = len({h._record().node_id for h in cross.executors}) == 2
+        packed = len({h._record().node_id for h in single.executors}) == 1
+
+        def build_shuffle(session):
+            with tenancy.use_session(session):
+                src = session.range(rows, num_partitions=8).with_column(
+                    "k", F.col("id") % 13
+                )
+                return dataset_to_dataframe(
+                    session, dataframe_to_dataset(src)
+                )
+
+        def run_round(session, df):
+            with tenancy.use_session(session):
+                t0 = time.perf_counter()
+                out = df.group_by("k").count().sort("k").collect()
+            return time.perf_counter() - t0, out
+
+        df_x, df_s = build_shuffle(cross), build_shuffle(single)
+        wire0, fetches0, doorbell0 = _wire_totals()
+        hits0 = obs.metrics.counter("planner.locality_hits").value
+        misses0 = obs.metrics.counter("planner.locality_misses").value
+        _, ref_x = run_round(cross, df_x)  # warm: compile + sockets
+        _, ref_s = run_round(single, df_s)
+        walls_x, walls_s, parity = [], [], ref_x == ref_s
+        for i in range(max(1, rounds)):
+            arms = ((cross, df_x), (single, df_s))
+            if i % 2:  # rotating lead
+                arms = arms[::-1]
+            for session, df in arms:
+                wall, out = run_round(session, df)
+                if session is cross:
+                    walls_x.append(wall)
+                    parity = parity and out == ref_x
+                else:
+                    walls_s.append(wall)
+                    parity = parity and out == ref_s
+
+        # deterministic small fit on each arm's materialized blocks: the
+        # cross arm streams training reads over the wire, and the final
+        # params must still match the single-host arm bit-for-bit
+        rng = np.random.default_rng(7)
+        pdf = pd.DataFrame(
+            {
+                "a": rng.random(4096).astype(np.float32),
+                "b": rng.random(4096).astype(np.float32),
+            }
+        )
+        pdf["y"] = 2 * pdf["a"] + 3 * pdf["b"]
+
+        def fit_leaves(session):
+            with tenancy.use_session(session):
+                frame = session.from_pandas(pdf, num_partitions=4)
+                ds = dataframe_to_dataset(frame.repartition(4))
+                est = JaxEstimator(
+                    model=MLPRegressor(), optimizer="adam", loss="mse",
+                    feature_columns=["a", "b"], label_column="y",
+                    batch_size=256, num_epochs=2, learning_rate=1e-3,
+                    shuffle=True, seed=0, streaming=True,
+                    donate_state=False,
+                )
+                est.fit(ds)
+            params = est.get_model().params
+            return [
+                np.asarray(leaf).copy()
+                for leaf in jax.tree_util.tree_leaves(params)
+            ]
+
+        fit_parity = all(
+            np.array_equal(a, b)
+            for a, b in zip(fit_leaves(cross), fit_leaves(single))
+        )
+
+        time.sleep(2.2)  # executor metric flushes are throttled at 2s
+        run_round(cross, df_x)  # one settling round flushes the stragglers
+        wire1, fetches1, doorbell1 = _wire_totals()
+        hits = int(obs.metrics.counter("planner.locality_hits").value - hits0)
+        misses = int(
+            obs.metrics.counter("planner.locality_misses").value - misses0
+        )
+        probed = hits + misses
+        rate = round(hits / probed, 4) if probed else None
+        bytes_over_wire = int(wire1 - wire0)
+        return {
+            "rows": rows,
+            "rounds": rounds,
+            "executor_cores_cross": cores_x,
+            "executor_cores_single": cores_s,
+            "spans_hosts": bool(spans),
+            "single_arm_packed": bool(packed),
+            "shuffle_wall_s": round(statistics.median(walls_x), 4),
+            "singlehost_shuffle_wall_s": round(
+                statistics.median(walls_s), 4
+            ),
+            "shuffle_wall_samples": [round(w, 4) for w in walls_x],
+            "singlehost_wall_samples": [round(w, 4) for w in walls_s],
+            "bytes_over_wire": bytes_over_wire,
+            "remote_fetches": int(fetches1 - fetches0),
+            "doorbell_tcp": int(doorbell1 - doorbell0),
+            "locality_hits": hits,
+            "locality_misses": misses,
+            "locality_hit_rate": rate,
+            "parity_ok": bool(parity),
+            "fit_parity_ok": bool(fit_parity),
+            "ok": bool(
+                parity and fit_parity and spans and packed
+                and bytes_over_wire > 0
+                and rate is not None and rate >= 0.8
+            ),
+        }
+    except Exception as exc:  # pragma: no cover - must not kill the bench
+        return {"ok": False, "error": repr(exc)[:300]}
+    finally:
+        if single is not None:
+            single.stop()
+        cross.stop()
+        try:
+            cluster_api.remove_node(agent_node_id)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (probe teardown best-effort)
+            pass
+
+
 def _etl_breakdown(stats):
     """Compact, JSON-ready view of the planner's last_query_stats: per-stage
     task counts, dispatch mode, and the server-side read/compute/emit phase
@@ -2069,6 +2267,11 @@ def main():
     # profiler overhead on the fit step p50 + live-MFU vs analytic parity
     fit_probe = fit_profile_probe()
 
+    # cross-host data plane probe (docs/cluster.md "Multi-host topology"):
+    # simulated second host, interleaved cross vs single-host shuffle
+    # rounds, bytes-over-wire + locality hit rate, byte-identical parity
+    crosshost_probe = crosshost_shuffle_probe()
+
     # export the whole run's trace (driver + head + executors under the
     # propagated trace ids) and the merged metrics registries — into the
     # gitignored artifacts/ dir, never the repo root
@@ -2110,6 +2313,7 @@ def main():
             "tenant_isolation_probe": tenant_probe,
             "obs_overhead_probe": obs_probe,
             "fit_profile_probe": fit_probe,
+            "crosshost_shuffle_probe": crosshost_probe,
             "dlrm": dlrm,
             "lm": bench_transformer_lm(),
             "parallel_steps": bench_parallel_steps(),

@@ -40,6 +40,7 @@ from raydp_tpu.cluster.common import (
     SHM_NS_ENV,
     ActorSpec,
     ClusterError,
+    host_id as common_host_id,
     recv_frame,
     rpc,
     send_frame,
@@ -365,6 +366,10 @@ class NodeAgent:
                     "node_ip": self.node_ip,
                     "agent_addr": self.addr,
                     "shm_ns": self.shm_ns,
+                    # host axis: RAYDP_TPU_HOST_ID when set (real multi-host
+                    # or the simulated harness), else the shm namespace —
+                    # which already has host granularity
+                    "host": common_host_id(),
                 },
             ),
             timeout=30,

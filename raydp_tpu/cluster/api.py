@@ -335,6 +335,12 @@ def shutdown() -> None:
 
     close_pooled_connections()
     close_actor_connections()  # doorbell sockets join the fd audit too
+    try:
+        from raydp_tpu.store.block_service import close_service_pool
+
+        close_service_pool()  # pooled block-fetch sockets too
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (store layer may not be loaded)
+        pass
     _sanitize.audit_leaks("cluster.shutdown")
 
 
@@ -592,16 +598,40 @@ class ActorHandle:
         fails at SEND was never delivered (peer-closed stream sockets fail
         the first write), so it silently falls through to a fresh connect."""
         from raydp_tpu.cluster.common import traced_request
+        from raydp_tpu.obs import metrics as _metrics
 
         # the caller's trace context rides the frame so executor-side
         # spans (task read/compute/emit) link under the driver's stage
         frame = traced_request((method, args, kwargs, no_reply))
-        # UNIX sockets only: the stale-at-SEND-was-never-delivered retry
-        # premise holds for UDS (a peer-closed stream fails the first write)
-        # but NOT for TCP, where a send into a dead peer succeeds until the
-        # RST arrives — a pooled tcp:// dispatch could silently vanish
-        use_doorbell = _doorbell_enabled() and not sock_path.startswith("tcp://")
+        # off-host actors speak the TCP actor protocol — same doorbell pool,
+        # one extra precaution. The stale-at-SEND-was-never-delivered retry
+        # premise holds for UDS unconditionally (a peer-closed stream fails
+        # the first write) but NOT for TCP, where a send into a dead peer
+        # succeeds until the RST arrives — so pooled tcp:// connections are
+        # liveness-probed before reuse: the actor never sends unsolicited
+        # bytes, hence a READABLE pooled socket can only be EOF/RST and is
+        # dropped. Past the probe, a TCP send-phase failure means the RST
+        # already arrived (never delivered — safe fresh-connect fallthrough)
+        # and a send that lands on a just-died peer surfaces at recv, the
+        # exact failure shape a per-call socket has always had.
+        is_tcp = sock_path.startswith("tcp://")
+        _metrics.counter(
+            "rpc.doorbell_tcp" if is_tcp else "rpc.doorbell_uds"
+        ).inc()
+        use_doorbell = _doorbell_enabled()
         pooled = _doorbell_take(sock_path) if use_doorbell else None
+        if pooled is not None and is_tcp:
+            try:
+                readable, _, _ = select.select([pooled], [], [], 0)
+            except (OSError, ValueError):
+                readable = [pooled]
+            if readable:
+                _metrics.counter("rpc.doorbell_tcp_evicted").inc()
+                try:
+                    pooled.close()
+                except OSError:  # raydp-lint: disable=swallowed-exceptions (already dead)
+                    pass
+                pooled = None
         if pooled is not None:
             try:
                 pooled.settimeout(timeout or 300.0)
@@ -793,6 +823,7 @@ def start_node_agent(
     shm_ns: Optional[str] = None,
     head_addr: Optional[str] = None,
     timeout: float = 60.0,
+    host: Optional[str] = None,
 ) -> Dict[str, str]:
     """Launch a node agent as a detached process and wait for it to register.
 
@@ -800,11 +831,16 @@ def start_node_agent(
     ``python -m raydp_tpu.cluster.agent <head_tcp> <ip> <ns> <dir> <json>``;
     this helper starts one on the local machine — with its own shm NAMESPACE,
     so it behaves exactly like a separate host: none of its blocks can be
-    mapped by other nodes, every cross-node read goes over TCP.
+    mapped by other nodes, every cross-node read goes over TCP. ``host``
+    names the simulated host on the cluster's host axis
+    (``RAYDP_TPU_HOST_ID`` in the agent's env, inherited by its actors);
+    it defaults to the namespace, which already has host granularity.
 
     Returns ``{"node_id", "addr", "dir"}``.
     """
     import json
+
+    from raydp_tpu.cluster.common import HOST_ID_ENV
 
     head = head_addr or head_tcp_addr()
     ns = shm_ns or f"n{uuid.uuid4().hex[:6]}"
@@ -812,6 +848,12 @@ def start_node_agent(
     local_dir = tempfile.mkdtemp(prefix=f"agent-{ns}-", dir=session_dir())
     env = dict(os.environ)
     env["PYTHONPATH"] = os.pathsep.join(p for p in sys.path if p)
+    if host is not None:
+        env[HOST_ID_ENV] = host
+    else:
+        # the agent must not inherit THIS process's host identity: its
+        # namespace is its (simulated) host
+        env.pop(HOST_ID_ENV, None)
     proc = subprocess.Popen(
         [
             sys.executable, "-S", "-m", "raydp_tpu.cluster.agent",

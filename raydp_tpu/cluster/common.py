@@ -32,6 +32,7 @@ TOKEN_FILE = "cluster.token"
 SESSION_ENV = "RAYDP_TPU_SESSION"
 HEAD_ADDR_ENV = "RAYDP_TPU_HEAD_ADDR"
 SHM_NS_ENV = "RAYDP_TPU_SHM_NS"
+HOST_ID_ENV = "RAYDP_TPU_HOST_ID"
 TOKEN_ENV = "RAYDP_TPU_TOKEN"
 DRIVER_OWNER = "__driver__"
 TOKEN_LEN = 32
@@ -207,6 +208,23 @@ def shm_namespace() -> str:
     only mapped directly when their namespace matches; everything else goes
     through the owning node's block server."""
     return os.environ.get(SHM_NS_ENV, "")
+
+
+def host_id() -> str:
+    """This process's host identity on the cluster's host axis. Real
+    multi-host deployments set ``RAYDP_TPU_HOST_ID`` per box; the simulated
+    multi-host harness (two agents on one machine with distinct shm
+    namespaces) falls back to the shm namespace, which already has exactly
+    host granularity — same namespace ⇒ blocks map locally, different
+    namespace ⇒ bytes cross the (possibly loopback) wire. Empty string is
+    the head's own host."""
+    return os.environ.get(HOST_ID_ENV) or shm_namespace()
+
+
+def host_label(host: str) -> str:
+    """Metric-safe token for a host id (flat dotted metric names — empty
+    host is the head's, dots would split the name)."""
+    return (host or "head").replace(".", "_")
 
 
 # ---------------------------------------------------------------------------
@@ -408,6 +426,10 @@ class NodeRecord:
     # over the network, never mapped)
     agent_addr: Optional[str] = None
     shm_ns: str = ""
+    # host axis (ISSUE 18): which physical (or simulated) host this node
+    # lives on. Placement scoring and transport selection key on it; ""
+    # means the head's own host. Defaults keep old pickles/ctors valid.
+    host: str = ""
 
 
 def actor_sock_path(session_dir: str, actor_id: str, incarnation: int) -> str:
@@ -468,6 +490,52 @@ def serve_block_bytes(shm_name: str, offset: int = 0, length: int = -1) -> bytes
     with open(path, "rb") as f:
         f.seek(offset)
         return f.read() if length < 0 else f.read(length)
+
+
+class RawView:
+    """A zero-copy reply payload: a read-only view over an mmap of the
+    block's backing file. When an actor method returns one, the worker's
+    serve loop streams the bytes straight from the page cache onto the
+    socket — ``("raw", size)`` header frame, then ``size`` raw bytes — with
+    no pickling and no intermediate copy. The handler, not the method, owns
+    closing it (the view must stay mapped until sendall returns)."""
+
+    __slots__ = ("view", "size", "_mm")
+
+    def __init__(self, mm, view: memoryview):
+        self._mm = mm
+        self.view = view
+        self.size = len(view)
+
+    def close(self) -> None:
+        try:
+            self.view.release()
+            if hasattr(self._mm, "close"):
+                self._mm.close()
+        except (BufferError, ValueError):  # raydp-lint: disable=swallowed-exceptions (a partially sent view may still be exported; the mmap closes with the process)
+            pass
+
+
+def serve_block_view(shm_name: str, offset: int = 0, length: int = -1) -> RawView:
+    """Zero-copy variant of ``serve_block_bytes``: mmap the block (either
+    tier) and return a :class:`RawView` over the requested range instead of
+    a copied ``bytes``. The streaming block server sends it with
+    ``sendall(view)`` — kernel reads pages straight from the segment."""
+    import mmap
+
+    if shm_name.startswith("file://"):
+        path = safe_spill_path(shm_name)
+    else:
+        path = os.path.join("/dev/shm", safe_shm_name(shm_name))
+    with open(path, "rb") as f:
+        total = os.fstat(f.fileno()).st_size
+        if total == 0:
+            # cannot mmap an empty file; an empty view needs no backing
+            return RawView(memoryview(b""), memoryview(b""))
+        mm = mmap.mmap(f.fileno(), 0, prot=mmap.PROT_READ)
+    end = total if length < 0 else min(total, offset + length)
+    start = min(offset, total)
+    return RawView(mm, memoryview(mm)[start:end])
 
 
 def unlink_block(shm_name: str) -> None:

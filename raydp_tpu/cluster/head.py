@@ -40,6 +40,7 @@ from raydp_tpu.cluster.common import (
     actor_sock_path,
     connect,
     head_sock_path,
+    host_id as common_host_id,
     recv_frame,
     rpc,
     send_frame,
@@ -217,6 +218,7 @@ class Head:
         node_ip: Optional[str] = None,
         agent_addr: Optional[str] = None,
         shm_ns: str = "",
+        host: str = "",
     ) -> str:
         node_id = f"node-{uuid.uuid4().hex[:8]}"
         if node_ip is None:
@@ -226,8 +228,13 @@ class Head:
         res.setdefault("CPU", 1.0)
         res.setdefault("memory", float(4 << 30))
         res[f"node:{node_ip}"] = 1.0
+        # host axis: agent-backed nodes report theirs (real box or simulated
+        # namespace); head-local virtual nodes share the head's own host
+        if not host and not agent_addr:
+            host = common_host_id()
         self.nodes[node_id] = NodeRecord(
-            node_id, node_ip, res, agent_addr=agent_addr, shm_ns=shm_ns
+            node_id, node_ip, res, agent_addr=agent_addr, shm_ns=shm_ns,
+            host=host or shm_ns,
         )
         self.node_available[node_id] = dict(res)
         return node_id
@@ -242,14 +249,18 @@ class Head:
         node_ip: str,
         agent_addr: str,
         shm_ns: str,
+        host: str = "",
     ):
         """A node agent (another host, or a separate-shm process standing in
         for one) joins the cluster: its actors spawn through the agent and
         its blocks are served by the agent's block server — the multi-host
-        parity of the reference's Ray nodes (SURVEY.md L1)."""
+        parity of the reference's Ray nodes (SURVEY.md L1). ``host`` is the
+        agent's position on the host axis (``RAYDP_TPU_HOST_ID``, falling
+        back to its shm namespace — docs/cluster.md "Multi-host topology")."""
         with self.lock:
             return self._add_node(
-                resources, node_ip, agent_addr=agent_addr, shm_ns=shm_ns
+                resources, node_ip, agent_addr=agent_addr, shm_ns=shm_ns,
+                host=host,
             )
 
     def handle_remove_node(self, node_id: str, only_if_empty: bool = False):
@@ -928,6 +939,32 @@ class Head:
         with self.lock:
             return self._service_for(shm_ns, tenant)
 
+    def handle_block_service_peers(self):
+        """Every LIVE, tcp-reachable block service with its host-axis row —
+        the spill-to-remote tier's target list (store._remote_spill_peer).
+        Only ALIVE services with a tcp socket qualify: a remote writer must
+        be able to dial the address it gets back right now."""
+        with self.lock:
+            rows = []
+            for (ns, tenant), actor_id in self.block_services.items():
+                actor = self.actors.get(actor_id)
+                if (
+                    actor is None
+                    or actor.state != ActorState.ALIVE
+                    or not actor.sock_path
+                    or not actor.sock_path.startswith("tcp://")
+                ):
+                    continue
+                node = self.nodes.get(actor.node_id) if actor.node_id else None
+                rows.append({
+                    "actor_id": actor_id,
+                    "shm_ns": ns,
+                    "tenant": tenant,
+                    "host": node.host if node is not None else ns,
+                    "service_addr": actor.sock_path,
+                })
+            return rows
+
     def _service_for(self, shm_ns: str, tenant: str) -> Optional[str]:  # guarded-by: self.lock|self.actor_state_cond held
         """The block service serving (namespace, tenant): the tenant-scoped
         entry first, then the namespace's tenant-less fallback. A tenant-
@@ -1195,6 +1232,9 @@ class Head:
             "owner": meta.owner,
             "node_id": meta.node_id,
             "shm_ns": meta.shm_ns,
+            # host axis: readers attribute bytes-over-wire per host edge
+            # and the planner scores placement against it
+            "host": node.host if node is not None else meta.shm_ns,
             "fetch_addr": fetch_addr,
         }
         # service-owned block: advertise the owner's own socket so remote
@@ -1302,6 +1342,22 @@ class Head:
                 for oid in object_ids
                 if oid in self.objects and not self.objects[oid].owner_died
             }
+
+    def handle_object_hosts(self, object_ids: List[str]):
+        """Batch block→(host, size) lookup — the host-axis twin of
+        ``object_locations`` the planner's reduce/exchange placement scorer
+        consumes (obs/costmodel.exchange_placement): it needs BYTES per
+        host, not just node ids, to put a reducer where its input lives."""
+        with self.lock:
+            out: Dict[str, tuple] = {}
+            for oid in object_ids:
+                meta = self.objects.get(oid)
+                if meta is None or meta.owner_died:
+                    continue
+                node = self.nodes.get(meta.node_id)
+                host = node.host if node is not None else meta.shm_ns
+                out[oid] = (host, meta.size)
+            return out
 
     def handle_block_fetch(self, shm_name: str, offset: int = 0, length: int = -1):
         """Serve a head-node block's bytes to a remote reader (the head plays

@@ -23,6 +23,7 @@ import traceback
 import cloudpickle
 
 from raydp_tpu.cluster.common import (
+    RawView,
     actor_sock_path,
     recv_frame,
     resolve_head_addr,
@@ -135,6 +136,19 @@ def _serve(
                 if no_reply:
                     continue
                 reply = future.result()
+                if reply[0] == "ok" and isinstance(reply[1], RawView):
+                    # streaming block reply: a ("raw", size) header frame,
+                    # then the mmap'd bytes straight onto the socket — no
+                    # pickle, no copy (store/block_service.py client side)
+                    raw = reply[1]
+                    try:
+                        send_frame(self.request, ("raw", raw.size))
+                        self.request.sendall(raw.view)
+                    except (ConnectionError, BrokenPipeError, OSError):
+                        return
+                    finally:
+                        raw.close()
+                    continue
                 try:
                     send_frame(self.request, reply)
                 except (ConnectionError, BrokenPipeError, OSError):

@@ -287,6 +287,117 @@ class Planner:
             prefs.append(candidates[i % len(candidates)] if candidates else None)
         return prefs
 
+    def _node_hosts(self) -> dict:
+        """node_id → host map from the head's node table (cached for the
+        planner's lifetime; hosts never change for a live node)."""
+        cache = getattr(self, "_node_host_cache", None)
+        if cache is None:
+            from raydp_tpu.cluster import api as cluster_api
+
+            try:
+                cache = {
+                    n.node_id: getattr(n, "host", "") or n.shm_ns
+                    for n in cluster_api.head_rpc("nodes")
+                }
+            except Exception:
+                cache = {}
+            self._node_host_cache = cache
+        return cache
+
+    def _executor_hosts(self) -> List[Optional[str]]:
+        """host per executor (the host axis of ``_executor_nodes``)."""
+        node_hosts = self._node_hosts()
+        return [
+            node_hosts.get(node) if node is not None else None
+            for node in self._executor_nodes()
+        ]
+
+    def _reduce_prefs(
+        self, specs: List[T.TaskSpec]
+    ) -> Optional[List[Optional[int]]]:
+        """Host-axis locality for reduce/exchange placement: score each
+        reducer with ``obs/costmodel.exchange_placement`` over the head's
+        block→host map and prefer an executor on the host holding the most
+        input bytes. Counts ``planner.locality_hits`` (a reducer landed
+        where its bytes live) vs ``planner.locality_misses`` (the best host
+        had no executor). Scoring only engages on a genuinely multi-host
+        pool — on one host every placement is equally local and the
+        counters would be noise."""
+        if len(self.executors) < 2:
+            return None
+        hosts = self._executor_hosts()
+        live_hosts = {h for h in hosts if h is not None}
+        if len(live_hosts) < 2:
+            return None
+        block_ids = list(
+            {
+                b.object_id
+                for spec in specs
+                for read in spec.reads
+                for b in read.blocks
+                if b is not None
+            }
+            | {
+                ref.object_id
+                for spec in specs
+                for read in spec.reads
+                for ref, _, _ in read.slices
+            }
+        )
+        if not block_ids:
+            return None
+        from raydp_tpu import obs
+        from raydp_tpu.cluster import api as cluster_api
+        from raydp_tpu.obs import costmodel
+
+        try:
+            object_hosts = cluster_api.head_rpc(
+                "object_hosts", object_ids=block_ids
+            )
+        except Exception:
+            return None
+        prefs: List[Optional[int]] = []
+        hits = misses = 0
+        for r, spec in enumerate(specs):
+            bytes_by_host: dict = {}
+            for read in spec.reads:
+                for b in read.blocks:
+                    if b is None:
+                        continue
+                    row = object_hosts.get(b.object_id)
+                    if row is None:
+                        continue
+                    host, size = row
+                    bytes_by_host[host] = (
+                        bytes_by_host.get(host, 0) + max(1, size)
+                    )
+                # indexed-shuffle inputs: the reducer reads a WINDOW of the
+                # map's single-block output — weigh the slice, not the block
+                for ref, _off, length in read.slices:
+                    row = object_hosts.get(ref.object_id)
+                    if row is None:
+                        continue
+                    host, _size = row
+                    bytes_by_host[host] = (
+                        bytes_by_host.get(host, 0) + max(1, length)
+                    )
+            best, _scores = costmodel.exchange_placement(bytes_by_host)
+            if best is None:
+                prefs.append(None)
+                continue
+            candidates = [j for j, h in enumerate(hosts) if h == best]
+            if candidates:
+                hits += 1
+                prefs.append(candidates[r % len(candidates)])
+            else:
+                misses += 1
+                prefs.append(None)
+        if hits:
+            obs.metrics.counter("planner.locality_hits").inc(hits)
+        if misses:
+            obs.metrics.counter("planner.locality_misses").inc(misses)
+        return prefs
+
     def submit(
         self,
         specs: List[T.TaskSpec],
@@ -2701,10 +2812,20 @@ class _ReduceLauncher:
         self._launched = True
         if not self.planner.executors:
             return  # local mode: gather() runs the specs inline
+        # host-axis locality (ISSUE 18): put each reducer where the most
+        # input bytes live. One batched head RPC; None (no preference)
+        # whenever the pool is single-host or the map is unavailable.
+        try:
+            prefs = self.planner._reduce_prefs(self.specs)
+        except Exception:
+            prefs = None
         self.dispatch_t = time.perf_counter()
         for r, spec in enumerate(self.specs):
             try:
-                self.futures[r] = self.planner._dispatch(spec, r, 0)
+                self.futures[r] = self.planner._dispatch(
+                    spec, r, 0,
+                    prefs[r] if prefs is not None else None,
+                )
             except Exception:
                 # eager dispatch is best-effort; gather()'s retry ladder
                 # re-dispatches a None slot through the normal failover

@@ -856,6 +856,48 @@ class _ProxyBlock:
         self._sealed = True
 
 
+class _RemoteBlock:
+    """Writable block for the spill-to-remote tier: buffers the Arrow
+    stream in anonymous memory and ships it to a PEER host's block service
+    at seal (local shm was full and under pressure — see
+    ``_spill_remote_target``). The peer's service becomes the owner of
+    record; on any shipping failure the bytes fall back to the local disk
+    tier, so remote spill is strictly opportunistic. Same interface as
+    WritableBlock/_SpillBlock/_ProxyBlock."""
+
+    def __init__(self, object_id: str, capacity: int, peer: dict):
+        import pyarrow as pa
+
+        self.object_id = object_id
+        self.capacity = capacity
+        self.peer = peer
+        self._out = pa.BufferOutputStream()
+        self._sealed = False
+
+    def arrow_sink(self):
+        return self._out
+
+    def seal(self, written: int, owner: Optional[str] = None) -> ObjectRef:
+        if self._sealed:
+            raise ClusterError("block already sealed")
+        if written > self.capacity:
+            raise ClusterError(f"wrote {written} past capacity {self.capacity}")
+        import pyarrow as pa
+
+        buf = pa.py_buffer(memoryview(self._out.getvalue())[:written])
+        self._sealed = True
+        try:
+            return _put_remote(self.object_id, buf, self.peer)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (remote tier is opportunistic; local disk always works)
+            from raydp_tpu.obs import metrics
+
+            metrics.counter("store.remote_spill_failures").inc()
+            return _put_spill(self.object_id, buf, owner)
+
+    def abort(self) -> None:
+        self._sealed = True
+
+
 def host_block_locally(
     object_id: str, payload: bytes, spill_dir: Optional[str] = None,
     storage: str = "auto",
@@ -903,6 +945,9 @@ def create_block(capacity: int, storage: str = "auto"):
     if storage == "disk":
         return _SpillBlock(object_id, capacity)
     if storage == "auto" and _should_spill(capacity):
+        peer = _spill_remote_target(capacity)
+        if peer is not None:
+            return _RemoteBlock(object_id, capacity, peer)
         return _SpillBlock(object_id, capacity)
     try:
         return WritableBlock(object_id, capacity)
@@ -924,6 +969,15 @@ def put(data, owner: Optional[str] = None, storage: str = "auto") -> ObjectRef:
         _proxy_put(object_id, bytes(memoryview(buf)), owner, storage=storage)
         return ObjectRef(object_id, buf.size)
     if storage == "disk" or (storage == "auto" and _should_spill(buf.size)):
+        if storage == "auto":
+            peer = _spill_remote_target(buf.size)
+            if peer is not None:
+                try:
+                    return _put_remote(object_id, buf, peer)
+                except Exception:  # raydp-lint: disable=swallowed-exceptions (remote tier is opportunistic; local disk always works)
+                    from raydp_tpu.obs import metrics
+
+                    metrics.counter("store.remote_spill_failures").inc()
         return _put_spill(object_id, buf, owner)
     lib = _load_native()
     ref = ObjectRef(object_id, buf.size)
@@ -958,6 +1012,101 @@ def _put_spill(object_id: str, buf, owner: Optional[str]) -> ObjectRef:
             pass
         raise
     return ref
+
+
+# ---------------------------------------------------------------------------
+# spill-to-remote: the third storage tier (ISSUE 18)
+#
+# tier order under "auto": local shm → (under memory pressure, with a peer
+# host available) a peer host's shm via its block service → local disk.
+# Remote beats disk only when this host is genuinely squeezed — the gate is
+# the conjunction of _should_spill (the write doesn't fit shm) and the
+# mem.pressure watermark the profiler maintains — so single-host runs and
+# unpressured spills keep the exact PR-era disk behavior.
+# ---------------------------------------------------------------------------
+
+REMOTE_SPILL_ENV = "RAYDP_TPU_REMOTE_SPILL"
+REMOTE_SPILL_PRESSURE_ENV = "RAYDP_TPU_REMOTE_SPILL_PRESSURE"
+# a remote spill is one pooled block_put frame; bigger blocks take local disk
+_REMOTE_SPILL_MAX = 256 << 20
+_PEER_CACHE_TTL_S = 5.0
+_peer_cache_lock = _sanitize.named_lock("store.remote_spill_peers", threading.Lock())
+_peer_cache: List = [0.0, None]  # guarded-by: _peer_cache_lock
+
+
+def _remote_spill_enabled() -> bool:
+    return os.environ.get(REMOTE_SPILL_ENV, "1").lower() not in ("0", "false", "no")
+
+
+def _remote_spill_pressure() -> float:
+    try:
+        return float(os.environ.get(REMOTE_SPILL_PRESSURE_ENV, "0.85"))
+    except ValueError:
+        return 0.85
+
+
+def _remote_spill_peer() -> Optional[dict]:
+    """A live block service on ANOTHER host (addr + namespace row), or None.
+    Cached a few seconds: the spill path must not add a head RPC per block
+    while a query churns through a full shm."""
+    import time as _time
+
+    from raydp_tpu.cluster.common import host_id
+
+    now = _time.monotonic()
+    with _peer_cache_lock:
+        stamp, peers = _peer_cache
+        if peers is None or now - stamp > _PEER_CACHE_TTL_S:
+            peers = ()
+            try:
+                from raydp_tpu.store.block_service import service_peers
+
+                peers = tuple(
+                    p for p in service_peers(exclude_host=host_id())
+                    if p.get("service_addr")
+                )
+            except Exception:  # raydp-lint: disable=swallowed-exceptions (no head / old head: remote tier simply unavailable)
+                peers = ()
+            _peer_cache[0] = now
+            _peer_cache[1] = peers
+    return peers[0] if peers else None
+
+
+def _spill_remote_target(capacity: int) -> Optional[dict]:
+    """The peer to remote-spill to, or None ⇒ take the local disk tier."""
+    if not _remote_spill_enabled() or capacity > _REMOTE_SPILL_MAX:
+        return None
+    try:
+        from raydp_tpu import obs
+
+        obs.sample_memory()
+        pressure = obs.metrics.gauge("mem.pressure").value
+    except Exception:  # raydp-lint: disable=swallowed-exceptions (no obs plane: treat as unpressured)
+        return None
+    if pressure < _remote_spill_pressure():
+        return None
+    return _remote_spill_peer()
+
+
+def _put_remote(object_id: str, buf, peer: dict) -> ObjectRef:
+    """Ship a block to a peer host's service and adopt the returned meta as
+    this process's cached location (owner = the peer service, namespace =
+    the peer host's — readers go through the normal remote-fetch path)."""
+    from raydp_tpu.cluster.common import host_id, host_label
+    from raydp_tpu.obs import metrics
+    from raydp_tpu.store.block_service import service_block_put
+
+    payload = bytes(memoryview(buf))
+    meta = service_block_put(peer["service_addr"], object_id, payload)
+    meta = dict(meta)
+    meta.setdefault("service_addr", peer["service_addr"])
+    cache_location(object_id, meta)
+    metrics.counter("store.blocks_spilled_remote").inc()
+    metrics.counter("rpc.bytes_over_wire").inc(len(payload))
+    src = host_label(host_id())
+    dst = host_label(meta.get("host", "") or meta.get("shm_ns", ""))
+    metrics.counter(f"rpc.bytes_over_wire.{src}.{dst}").inc(len(payload))
+    return ObjectRef(object_id, len(payload))
 
 
 def _lookup(ref: ObjectRef, fresh: bool = False) -> dict:
@@ -1120,14 +1269,20 @@ def _fetch_deadline_s() -> float:
 
 
 def _fetch_chunk(
-    ref: ObjectRef, meta: dict, offset: int, length: int, deadline: float
-) -> bytes:
+    ref: ObjectRef, meta: dict, offset: int, length: int, deadline: float,
+    into: Optional[memoryview] = None,
+):
     """One ranged chunk pull with the jittered-backoff retry ladder.
     Prefers the block service's own socket (``service_addr`` — the
-    first-class owner) over the node's agent/head ``fetch_addr``; every few
-    failed attempts the location is re-resolved through the head, so a
-    service that restarted onto a fresh socket is found mid-ladder (and an
-    owner the head reports dead propagates OwnerDiedError → lineage)."""
+    first-class owner, over the ISSUE-18 pooled streaming transport) over
+    the node's agent/head ``fetch_addr``; every few failed attempts the
+    location is re-resolved through the head, so a service that restarted
+    onto a fresh socket is found mid-ladder (and an owner the head reports
+    dead propagates OwnerDiedError → lineage).
+
+    With ``into`` the chunk is received directly into the caller's
+    destination view (the parallel assembly path — no join copy) and the
+    byte count is returned; otherwise the bytes are returned."""
     import random
     import socket as _socket
     import time as _time
@@ -1143,9 +1298,14 @@ def _fetch_chunk(
                 from raydp_tpu.store.block_service import service_block_fetch
 
                 return service_block_fetch(
-                    service_addr, meta["shm_name"], offset, length
+                    service_addr, meta["shm_name"], offset, length, into=into
                 )
-            return rpc(meta["fetch_addr"], ("block_fetch", request), timeout=300)
+            data = rpc(meta["fetch_addr"], ("block_fetch", request), timeout=300)
+            if into is None:
+                return data
+            view = memoryview(data)
+            into[: len(view)] = view
+            return len(view)
         except (ConnectionError, EOFError, _socket.timeout, OSError) as exc:
             if isinstance(exc, FileNotFoundError):
                 # a remote "segment/file is gone" is NOT transient: the
@@ -1188,45 +1348,106 @@ def _fetch_chunk(
                 request["shm_name"] = meta["shm_name"]
 
 
-def _remote_fetch(ref: ObjectRef, meta: dict, offset: int, length: int) -> bytes:
+def _fetch_parallelism() -> int:
+    try:
+        return max(1, int(os.environ.get("RAYDP_TPU_FETCH_PARALLEL", "4")))
+    except ValueError:
+        return 4
+
+
+def _count_over_wire(meta: dict, nbytes: int, fetches: int = 1) -> None:
+    """The observatory's view of the cross-host data plane: every remote
+    byte is counted, totalled and per host edge. Flat dotted names stand in
+    for labels (metrics.py has none): ``rpc.bytes_over_wire`` is the total,
+    ``rpc.bytes_over_wire.<src_host>.<dst_host>`` one directed edge —
+    src is the host SERVING the bytes, dst the host reading them."""
+    from raydp_tpu.cluster.common import host_id, host_label
+    from raydp_tpu.obs import metrics
+
+    metrics.counter("rpc.remote_fetches").inc(fetches)
+    metrics.counter("rpc.bytes_over_wire").inc(nbytes)
+    src = host_label(meta.get("host", "") or meta.get("shm_ns", ""))
+    dst = host_label(host_id())
+    metrics.counter(f"rpc.bytes_over_wire.{src}.{dst}").inc(nbytes)
+    from raydp_tpu.obs import flush_throttled
+
+    flush_throttled(2.0)
+
+
+def _remote_fetch(ref: ObjectRef, meta: dict, offset: int, length: int):
     """Ranged network pull of ``[offset, offset+length)`` from the owning
     node's block server (chunked: stays under the wire frame cap for
     arbitrarily large reads and bounds per-chunk copies). The server's
     ``block_fetch`` is range-native, so a reducer pulling its slice of an
     indexed shuffle block moves only that slice's bytes over the network.
-    Each chunk rides the retry ladder (``_fetch_chunk``): a restarting
-    block service degrades to backoff-and-retry, then to lineage recovery
-    at the deadline, never to a raw ConnectionRefusedError."""
+    Multi-chunk reads fan out in parallel over the service connection pool,
+    each chunk landing directly in its slice of one preallocated buffer —
+    no join copy. Each chunk rides the retry ladder (``_fetch_chunk``): a
+    restarting block service degrades to backoff-and-retry, then to
+    lineage recovery at the deadline, never to a raw
+    ConnectionRefusedError."""
     import time as _time
 
     chunk = 64 << 20
-    parts = []
-    pulled = 0
-    # one shared copy: a mid-ladder re-resolution in _fetch_chunk updates
-    # it in place, so every later chunk starts at the live address
-    meta = dict(meta)
     deadline = _time.monotonic() + _fetch_deadline_s()
-    while pulled < length:
-        part = _fetch_chunk(
-            ref, meta, offset + pulled, min(chunk, length - pulled), deadline
+    nchunks = max(1, -(-length // chunk))
+    workers = min(_fetch_parallelism(), nchunks)
+    if nchunks == 1 or workers <= 1:
+        parts = []
+        pulled = 0
+        # one shared copy: a mid-ladder re-resolution in _fetch_chunk
+        # updates it in place, so every later chunk starts at the live
+        # address
+        meta = dict(meta)
+        while pulled < length:
+            part = _fetch_chunk(
+                ref, meta, offset + pulled, min(chunk, length - pulled), deadline
+            )
+            if not part:
+                break
+            parts.append(part)
+            pulled += len(part)
+        data = parts[0] if len(parts) == 1 else b"".join(parts)
+    else:
+        from concurrent.futures import ThreadPoolExecutor
+
+        buf = bytearray(length)
+        mv = memoryview(buf)
+        src = dict(meta)
+
+        def pull(i: int) -> int:
+            start = i * chunk
+            ln = min(chunk, length - start)
+            # per-worker meta copy: the in-place re-resolution contract
+            # assumes a single ladder walking the dict; concurrent ladders
+            # each re-resolve their own
+            return _fetch_chunk(
+                ref, dict(src), offset + start, ln, deadline,
+                into=mv[start:start + ln],
+            )
+
+        with ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="rtpu-fetch"
+        ) as pool:
+            counts = list(pool.map(pull, range(nchunks)))
+        short = sum(
+            1 for i, n in enumerate(counts)
+            if n < min(chunk, length - i * chunk)
         )
-        if not part:
-            break
-        parts.append(part)
-        pulled += len(part)
-    data = parts[0] if len(parts) == 1 else b"".join(parts)
+        data = buf if not short else bytes()
     stats["remote_fetches"] += 1
     stats["remote_bytes"] += len(data)
     from raydp_tpu.obs import metrics
 
     metrics.counter("store.remote_fetches").inc()
     metrics.counter("store.remote_bytes").inc(len(data))
+    _count_over_wire(meta, len(data))
     if len(data) < length:
         raise ClusterError(
             f"object {ref.object_id} remote fetch truncated: "
             f"{len(data)} < {length}"
         )
-    return data[:length]
+    return data if len(data) == length else data[:length]
 
 
 def _retry_uncached(ref: ObjectRef, meta: Optional[dict], exc: BaseException):

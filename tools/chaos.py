@@ -45,10 +45,19 @@ lineage re-execution charged to it — one tenant's failure (and recovery)
 must never leak into another's blocks, plans, or results
 (docs/multitenancy.md).
 
+The cross-host plane adds a fifth tier: ``host_death`` boots a second
+SIMULATED host (a node agent with its own shm namespace — TCP-only
+reachability, docs/cluster.md "Multi-host topology"), spans a session
+across both, and SIGKILLs every actor sharing the simulated host
+mid-query. The gate is two-tier: the dead host's executor-owned blocks
+come back via lineage, while the surviving host's service-owned blocks
+never re-execute — byte-identical either way
+(docs/fault_tolerance.md kill matrix).
+
 ``--quick`` runs the CI slice (mid-shuffle + mid-fit lineage kills, both
-block-service tiers, the tenant-isolation kill, and the replica kill);
-without it the full scenario list runs (adds the compiled-dispatch kill
-and the elasticity round-trip). ``--seed``
+block-service tiers, the tenant-isolation kill, the replica kill, and the
+simulated host death); without it the full scenario list runs (adds the
+compiled-dispatch kill and the elasticity round-trip). ``--seed``
 makes victim/timing selection deterministic (unseeded runs keep the fixed
 legacy choices). Exit code is non-zero when any query went unrecovered or
 any sanitizer finding surfaced. The same scenario bodies are reused by
@@ -1000,6 +1009,134 @@ def scenario_replica_kill_during_decode(
         raydp_tpu.stop_etl()
 
 
+def scenario_host_death(rows: int = 60_000) -> dict:
+    """SIGKILL every actor sharing one SIMULATED host mid-query (the
+    cross-host plane's whole-box failure: docs/fault_tolerance.md kill
+    matrix, docs/cluster.md "Multi-host topology").
+
+    A node agent with its own shm namespace stands in for the second host:
+    its executors' blocks live in a namespace nobody else can map, so its
+    death is REAL loss (no service serves that namespace) and recovery must
+    come through lineage on the surviving host. The head host's blocks are
+    SERVICE-owned; the service survives, so they must come back without a
+    single re-executed task. Gate: post-death query byte-identical, lineage
+    re-execution ≥ 1 (the dead host) and bounded, service ownership intact
+    (the surviving host)."""
+    import raydp_tpu
+    from raydp_tpu.cluster import api as cluster_api
+    from raydp_tpu.etl import functions as F
+    from raydp_tpu.exchange import dataframe_to_dataset, dataset_to_dataframe
+    from raydp_tpu.store import object_store as store
+
+    if not cluster_api.is_initialized():
+        cluster_api.init(num_cpus=4, memory=4 << 30)
+    # size executors from LIVE free head resources so the second one cannot
+    # fit on the head node and must land on the simulated host (the sizing
+    # trick tests/test_multihost.py uses)
+    head_node = next(
+        n for n in cluster_api.nodes() if n.agent_addr is None and n.alive
+    )
+    head_free = cluster_api.available_resources()[head_node.node_id].get(
+        "CPU", 0.0
+    )
+    cores = int(head_free // 2 + 1)
+    info = cluster_api.start_node_agent(
+        {"CPU": float(cores), "memory": float(1 << 30)}, shm_ns="chd"
+    )
+    agent_node_id = info["node_id"]
+    session = raydp_tpu.init_etl(
+        "chaos-host-death", num_executors=2, executor_cores=cores,
+        executor_memory="300M",
+    )
+    try:
+        victims = [
+            h for h in session.executors
+            if h._record().node_id == agent_node_id
+        ]
+        spans_hosts = 0 < len(victims) < len(session.executors)
+        src = session.range(rows, num_partitions=8).with_column(
+            "k", F.col("id") % 13
+        )
+        ds = dataframe_to_dataset(src)
+        svc_id = (
+            session.block_service._actor_id
+            if session.block_service is not None else None
+        )
+        svc_owned = [b for b in ds.blocks if store.owner_of(b) == svc_id]
+        victim_ids = {h._actor_id for h in victims}
+        host_owned = [b for b in ds.blocks if store.owner_of(b) in victim_ids]
+        df = dataset_to_dataframe(session, ds)
+        clean = df.group_by("k").count().sort("k").collect()
+        before = lineage_counters()
+
+        # deterministic half: the whole simulated host dies between queries
+        # — every actor sharing it (its executors; the namespace hosts no
+        # block service) SIGKILLed, its executor-owned blocks tombstoned —
+        # and the next query must lineage-recover them on the survivor
+        for victim in victims:
+            kill_executor(session, handle=victim)
+        time.sleep(0.3)
+        chaos = df.group_by("k").count().sort("k").collect()
+        session.request_total_executors(2)
+
+        # racing half: the host dies again WHILE a query is in flight (the
+        # respawned executor cannot fit on the head — the sizing above —
+        # so it landed back on the simulated host)
+        victims2 = [
+            h for h in session.executors
+            if h._record().node_id == agent_node_id
+        ]
+
+        def _fire():
+            time.sleep(jittered(0.05))
+            for victim in victims2:
+                try:
+                    kill_executor(session, handle=victim)
+                except Exception:  # raydp-lint: disable=swallowed-exceptions (chaos timer: racing scenario teardown)
+                    pass
+
+        killer = threading.Thread(
+            target=_fire, name="chaos-host-killer", daemon=True
+        )
+        killer.start()
+        chaos2 = df.group_by("k").count().sort("k").collect()
+        killer.join()
+        session.request_total_executors(2)
+
+        # the surviving host's service-owned blocks never left the service
+        service_intact = all(store.owner_of(b) == svc_id for b in svc_owned)
+
+        after = lineage_counters()
+        reexecuted = after["reexecuted_tasks"] - before["reexecuted_tasks"]
+        identical = chaos == clean and chaos2 == clean
+        # bound: ≤ one 8-task map round + one transitive source level per
+        # host-death event (two events); the surviving host's service-owned
+        # blocks must contribute zero
+        bound = 32
+        return {
+            "name": "host_death",
+            "ok": bool(
+                identical and spans_hosts and reexecuted >= 1
+                and service_intact and len(svc_owned) >= 1
+                and len(host_owned) >= 1
+            ),
+            "byte_identical": bool(identical),
+            "spans_hosts": bool(spans_hosts),
+            "dead_host_blocks": len(host_owned),
+            "surviving_service_blocks": len(svc_owned),
+            "surviving_service_intact": bool(service_intact),
+            "reexecuted_tasks": reexecuted,
+            "reexecution_bound": bound,
+            "within_bound": reexecuted <= bound,
+        }
+    finally:
+        raydp_tpu.stop_etl()
+        try:
+            cluster_api.remove_node(agent_node_id)
+        except Exception:  # raydp-lint: disable=swallowed-exceptions (teardown: node may already be gone at cluster shutdown)
+            pass
+
+
 QUICK = (
     scenario_mid_shuffle,
     scenario_mid_fit,
@@ -1008,6 +1145,7 @@ QUICK = (
     scenario_tenant_kill_isolation,
     scenario_replica_kill_during_load,
     scenario_replica_kill_during_decode,
+    scenario_host_death,
 )
 FULL = (
     scenario_mid_shuffle,
@@ -1019,6 +1157,7 @@ FULL = (
     scenario_elasticity,
     scenario_replica_kill_during_load,
     scenario_replica_kill_during_decode,
+    scenario_host_death,
 )
 
 

@@ -78,6 +78,12 @@ STATS: Dict[str, Tuple[str, str]] = {
     "tenant_p99_ratio": ("detail.tenant_isolation_probe.p99_ratio", "lower"),
     "lm_mfu": ("detail.lm.mfu", "higher"),
     "fit_mfu": ("detail.fit_profile_probe.mfu_live", "higher"),
+    "crosshost_shuffle_s": (
+        "detail.crosshost_shuffle_probe.shuffle_wall_s", "lower"
+    ),
+    "crosshost_locality_hit_rate": (
+        "detail.crosshost_shuffle_probe.locality_hit_rate", "higher"
+    ),
 }
 
 

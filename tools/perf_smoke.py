@@ -71,7 +71,13 @@ when:
 - the live-MFU parity check failed: the estimator's live FLOPs accounting
   (XLA cost analysis, the ``estimator.mfu`` gauge) and the cost-model's
   analytic FLOPs for the same model must agree within the probe's
-  tolerance (docs/observability.md "Compute observatory").
+  tolerance (docs/observability.md "Compute observatory");
+- the cross-host probe failed (``crosshost_shuffle_probe``,
+  docs/cluster.md "Multi-host topology"): the simulated 2-host shuffle +
+  fit must be byte-identical to the single-host arm, the remote arm must
+  move > 0 bytes over the wire (``rpc.bytes_over_wire``), and the reduce
+  placement locality hit rate (``planner.locality_hits / (hits+misses)``)
+  must be ≥ 0.8.
 
 Usage: ``python tools/perf_smoke.py [artifact.json]``
 """
@@ -193,6 +199,7 @@ def main() -> int:
         "etl_breakdown": detail.get("etl_breakdown", {}),
         "shuffle_probe": detail.get("shuffle_probe", {}),
         "fit_profile_probe": detail.get("fit_profile_probe", {}),
+        "crosshost_shuffle_probe": detail.get("crosshost_shuffle_probe", {}),
         "reference_etl_query_s": reference,
         "reference_burst_p50_ms": burst_ref,
         "reference_streaming_vs_scan": _snapshot_value("streaming_vs_scan"),
@@ -428,6 +435,27 @@ def main() -> int:
                 f"indexed shuffle wrote {entry['blocks']} blocks for "
                 f"{entry['map_tasks']} map tasks (expected M, not M×R)"
             )
+    xhost = artifact["crosshost_shuffle_probe"]
+    if xhost:
+        if not (xhost.get("parity_ok") and xhost.get("fit_parity_ok")):
+            failures.append(
+                f"cross-host parity failed: {xhost} (the simulated 2-host "
+                "shuffle + fit must be byte-identical to single-host)"
+            )
+        rate = xhost.get("locality_hit_rate")
+        if rate is None or rate < 0.8:
+            failures.append(
+                f"cross-host locality hit rate {rate} below 0.8 (reduce "
+                "placement must follow the input bytes on a multi-host "
+                "pool)"
+            )
+        if int(xhost.get("bytes_over_wire", 0)) <= 0:
+            failures.append(
+                "cross-host probe moved zero bytes over the wire (the "
+                "remote arm never exercised the cross-host data plane)"
+            )
+    else:
+        failures.append("crosshost_shuffle_probe missing from bench detail")
     if failures:
         for f_ in failures:
             print(f"PERF-SMOKE FAIL: {f_}", file=sys.stderr)
