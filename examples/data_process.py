@@ -1,6 +1,7 @@
 """Pure-ETL pipeline — the reference's data_process.py: load, feature
 engineering, groupby aggregation, join, sorted report — exercising the
 distributed DataFrame engine with no training stage."""
+# raydp-lint: disable-file=print-diagnostics  (examples narrate to stdout by design — they run standalone, before any obs plane exists)
 
 import os
 

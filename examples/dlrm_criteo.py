@@ -6,6 +6,7 @@ notebook's category→id step), embedding tables are vocab-sharded over the
 Synthetic Criteo-shaped data by default; argv[1] = path to a Criteo tsv
 sample to run the real preprocessing (13 int + 26 categorical columns).
 """
+# raydp-lint: disable-file=print-diagnostics  (examples narrate to stdout by design — they run standalone, before any obs plane exists)
 
 import os
 import sys

@@ -6,6 +6,7 @@ Run under a CPU mesh for demonstration:
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
       python examples/long_context_lm.py
 """
+# raydp-lint: disable-file=print-diagnostics  (examples narrate to stdout by design — they run standalone, before any obs plane exists)
 
 import dataclasses
 

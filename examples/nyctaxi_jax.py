@@ -6,6 +6,7 @@ trained data-parallel on the device mesh.
 Uses synthetic taxi-shaped data by default; pass a parquet directory of real
 NYCTaxi data as argv[1] to run on it.
 """
+# raydp-lint: disable-file=print-diagnostics  (examples narrate to stdout by design — they run standalone, before any obs plane exists)
 
 import os
 import sys

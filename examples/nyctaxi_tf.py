@@ -1,6 +1,7 @@
 """NYCTaxi with TFEstimator — the reference's tensorflow_nyctaxi.py
 (examples/tensorflow_nyctaxi.py:20-22) on this framework: keras MLP trained
 with MultiWorkerMirroredStrategy ranks on the SPMD launcher."""
+# raydp-lint: disable-file=print-diagnostics  (examples narrate to stdout by design — they run standalone, before any obs plane exists)
 
 import os
 

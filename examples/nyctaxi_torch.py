@@ -1,6 +1,7 @@
 """NYCTaxi with TorchEstimator — the reference's pytorch_nyctaxi.py
 (examples/pytorch_nyctaxi.py:22-24,71-75) on this framework: same ETL
 pipeline, torch MLP trained with DDP (gloo) ranks on the SPMD launcher."""
+# raydp-lint: disable-file=print-diagnostics  (examples narrate to stdout by design — they run standalone, before any obs plane exists)
 
 import os
 

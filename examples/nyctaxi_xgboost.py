@@ -2,6 +2,7 @@
 (examples/xgboost_ray_nyctaxi.py:41-47) on this framework: distributed GBDT
 over SPMD rank actors. Runs on xgboost's collective when installed, otherwise
 on the built-in distributed histogram GBDT (estimator/gbdt_native.py)."""
+# raydp-lint: disable-file=print-diagnostics  (examples narrate to stdout by design — they run standalone, before any obs plane exists)
 
 import os
 

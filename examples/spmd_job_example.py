@@ -1,5 +1,6 @@
 """SPMD job launcher — the reference's doc/mpi.md example reshaped: ship a
 function to a gang of rank actors and gather results (no mpirun, no gRPC)."""
+# raydp-lint: disable-file=print-diagnostics  (examples narrate to stdout by design — they run standalone, before any obs plane exists)
 
 import raydp_tpu
 

@@ -348,7 +348,7 @@ class NodeAgent:
                     reply = ("err", exc)
                 try:
                     send_frame(self.request, reply)
-                except (ConnectionError, BrokenPipeError):  # raydp-lint: disable=swallowed-exceptions (peer hung up; no one left to reply to)
+                except ConnectionError:  # raydp-lint: disable=swallowed-exceptions (peer hung up; no one left to reply to)
                     pass
 
         class Server(socketserver.ThreadingTCPServer):

@@ -636,7 +636,7 @@ class ActorHandle:
             try:
                 pooled.settimeout(timeout or 300.0)
                 send_frame(pooled, frame)
-            except (ConnectionError, OSError):
+            except OSError:
                 try:
                     pooled.close()
                 except OSError:  # raydp-lint: disable=swallowed-exceptions (closing the stale doorbell before the fresh connect)
@@ -648,7 +648,7 @@ class ActorHandle:
                 return ActorFuture(pooled, timeout, pool_key=sock_path)
         try:
             sock = connect(sock_path, timeout=timeout or 300.0)
-        except (ConnectionError, FileNotFoundError, OSError) as exc:
+        except OSError as exc:
             raise _ConnectFailed(str(exc)) from exc
         try:
             send_frame(sock, frame)
@@ -691,7 +691,7 @@ class ActorHandle:
                     return future
                 except _ConnectFailed:  # raydp-lint: disable=swallowed-exceptions (never delivered; retried until the deadline)
                     pass  # never delivered: retry freely until the deadline
-                except (ConnectionError, OSError):
+                except OSError:
                     sends_failed += 1
                     if sends_failed > retries:
                         raise

@@ -52,6 +52,15 @@ class OwnerDiedError(ClusterError):
     test_data_owner_transfer.py:33-77)."""
 
 
+class ProgramCacheMiss(ClusterError):
+    """Raised by an executor asked to run a program id it has never seen
+    (cache evicted / actor restarted): the driver re-dispatches with the
+    program body attached. Picklable with its single string arg; defined
+    here (not in etl/program.py) because it crosses the executor RPC
+    boundary and the catching process must be able to unpickle it without
+    the etl import set."""
+
+
 class TenantQuotaError(ClusterError):
     """A tenant exceeded one of its quotas (max block bytes at the head,
     max in-flight / queued tasks at the fair-share scheduler). Typed so
@@ -139,7 +148,7 @@ def verify_token(sock: socket.socket, expected: bytes) -> bool:
         nonce = os.urandom(TOKEN_LEN)
         sock.sendall(nonce)
         presented = _recv_exact(sock, hashlib.sha256().digest_size)
-    except (ConnectionError, OSError):
+    except OSError:
         return False
     digest = hmac.new(expected, nonce, hashlib.sha256).digest()
     return hmac.compare_digest(presented, digest)
@@ -353,7 +362,7 @@ def rpc_pooled(sock_path: str, request: Tuple, timeout: Optional[float] = 60.0) 
             # is poisoned (a late reply would desync the stream), so drop it.
             _pool_drop(sock_path)
             raise
-        except (ConnectionError, EOFError, OSError):
+        except (EOFError, OSError):
             _pool_drop(sock_path)
             if attempt or fresh:
                 raise
@@ -586,10 +595,10 @@ class ZygoteProc:
 
         try:
             os.killpg(self.pid, _signal.SIGKILL)
-        except (ProcessLookupError, PermissionError, OSError):
+        except OSError:
             try:
                 os.kill(self.pid, _signal.SIGKILL)
-            except (ProcessLookupError, PermissionError, OSError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead process is idempotent)
+            except OSError:  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead process is idempotent)
                 pass
 
     def poll(self) -> Optional[int]:
@@ -901,7 +910,7 @@ def _zygote_request(run_dir: str, req: Dict[str, Any], wait_s: float = 15.0):
     try:
         send_frame(sock, req)
         status, pid = recv_frame(sock)
-    except (ConnectionError, OSError):
+    except OSError:
         return None
     finally:
         sock.close()

@@ -599,7 +599,7 @@ class Head:
             if stale:
                 try:
                     os.killpg(proc.pid, signal.SIGKILL)
-                except (ProcessLookupError, PermissionError, OSError):  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead raced spawn is idempotent)
+                except OSError:  # raydp-lint: disable=swallowed-exceptions (kill of an already-dead raced spawn is idempotent)
                     pass
 
         threading.Thread(target=_local_spawn, daemon=True).start()
@@ -1986,7 +1986,7 @@ class _Handler(socketserver.BaseRequestHandler):
         while True:
             try:
                 frame = recv_frame(self.request)
-            except (ConnectionError, EOFError, OSError):
+            except (EOFError, OSError):
                 return
             frame, trace_ctx = unwrap_traced(frame)
             method, kwargs = frame
@@ -2011,7 +2011,7 @@ class _Handler(socketserver.BaseRequestHandler):
                 reply = ("err", exc)
             try:
                 send_frame(self.request, reply)
-            except (ConnectionError, BrokenPipeError, OSError):
+            except OSError:
                 return
             except Exception:
                 # unpicklable reply: report it without severing the pooled
@@ -2021,7 +2021,7 @@ class _Handler(socketserver.BaseRequestHandler):
                         self.request,
                         ("err", ClusterError("head reply could not be serialized")),
                     )
-                except (ConnectionError, BrokenPipeError, OSError):
+                except OSError:
                     return
 
 

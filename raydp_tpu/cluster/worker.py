@@ -99,7 +99,7 @@ def _serve(
             while True:
                 try:
                     frame = recv_frame(self.request)
-                except (ConnectionError, EOFError, OSError):
+                except (EOFError, OSError):
                     return
                 # traced frames wrap the call tuple in an ("__obs__", ctx, …)
                 # envelope; the caller's (trace, span) context is adopted for
@@ -144,14 +144,14 @@ def _serve(
                     try:
                         send_frame(self.request, ("raw", raw.size))
                         self.request.sendall(raw.view)
-                    except (ConnectionError, BrokenPipeError, OSError):
+                    except OSError:
                         return
                     finally:
                         raw.close()
                     continue
                 try:
                     send_frame(self.request, reply)
-                except (ConnectionError, BrokenPipeError, OSError):
+                except OSError:
                     return
                 except Exception as exc:  # unpicklable result: report, don't sever
                     try:
@@ -165,7 +165,7 @@ def _serve(
                                 ),
                             ),
                         )
-                    except (ConnectionError, BrokenPipeError, OSError):
+                    except OSError:
                         return
 
     if use_tcp:

@@ -25,7 +25,7 @@ import hashlib
 from dataclasses import dataclass, field, replace
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
-from raydp_tpu.cluster.common import ClusterError
+from raydp_tpu.cluster.common import ProgramCacheMiss  # noqa: F401 (canonical home: crosses the executor RPC boundary, so it lives with the cluster errors; re-exported here for compatibility)
 from raydp_tpu.etl import plan as lp
 from raydp_tpu.etl import tasks as T
 from raydp_tpu.etl.expressions import (
@@ -41,12 +41,6 @@ from raydp_tpu.etl.expressions import (
     Udf,
     When,
 )
-
-
-class ProgramCacheMiss(ClusterError):
-    """Raised by an executor asked to run a program id it has never seen
-    (cache evicted / actor restarted): the driver re-dispatches with the
-    program body attached. Picklable with its single string arg."""
 
 
 # ---------------------------------------------------------------------------

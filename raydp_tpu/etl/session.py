@@ -251,7 +251,12 @@ class EtlSession:
             if self._pg is not None:
                 indexes = self._bundle_indexes or list(range(num_executors))
                 bundle = indexes[i % len(indexes)]
-            deadline = time.monotonic() + 15.0
+            # 60s covers the worst drain: a stopped tenant's executor in a
+            # crash-restart loop (respawn → dead-master connect timeout →
+            # crash, × max_restarts) holds its CPU charge for several
+            # 15s-plus cycles before the head marks it DEAD and credits
+            # the resources back
+            deadline = time.monotonic() + 60.0
             while True:
                 try:
                     handle = cluster.spawn(
@@ -479,8 +484,8 @@ class EtlSession:
         #   obs.dossier_dir      — where crash dossiers land
         self.scrape_addr: Optional[tuple] = None
         scrape_conf = str(self.configs.get("obs.scrape_port", "off")).lower()
-        ring_conf = self.configs.get("obs.head_ring_spans")
-        dossier_conf = self.configs.get("obs.dossier_dir")
+        ring_conf = self.configs.get("obs.head_ring_spans", None)
+        dossier_conf = self.configs.get("obs.dossier_dir", None)
         if scrape_conf not in ("off", "", "false") or ring_conf or dossier_conf:
             try:
                 settings = cluster.head_rpc(

@@ -130,7 +130,7 @@ class Deployment:
             if current < target:
                 try:
                     handle = self._spawn_one()
-                except (ClusterError, ConnectionError, OSError):
+                except (ClusterError, OSError):
                     # cluster unreachable (teardown racing a heal tick) or
                     # spawn rejected: serve on with the survivors rather
                     # than wedging the controller in a spawn-retry loop

@@ -20,7 +20,7 @@ from __future__ import annotations
 import functools
 from typing import Any, Callable, Dict, List, Optional
 
-from raydp_tpu.cluster.common import ActorDiedError, ClusterError
+from raydp_tpu.cluster.common import ClusterError
 
 
 def _invoke(fit_fn, resume_from_epoch, ctx):
@@ -77,7 +77,6 @@ def elastic_fit(
                 functools.partial(_invoke, fit_fn, resume), timeout=timeout
             )
         except (
-            ActorDiedError,
             ClusterError,
             ConnectionError,
             EOFError,

@@ -389,7 +389,7 @@ def service_block_fetch(
             result, _ = _service_call(
                 sock, "block_fetch", (shm_name, offset, length), into
             )
-    except (ConnectionError, socket.timeout, OSError, BrokenPipeError) as exc:
+    except OSError as exc:
         if getattr(exc, "_raydp_stream_clean", False):
             _pool.release(addr, sock)  # app error in OSError clothing
         else:
@@ -416,7 +416,7 @@ def service_block_put(
         result, _ = _service_call(
             sock, "block_put", (object_id, bytes(payload), storage), None
         )
-    except (ConnectionError, socket.timeout, OSError, BrokenPipeError) as exc:
+    except OSError as exc:
         if getattr(exc, "_raydp_stream_clean", False):
             _pool.release(addr, sock)
         else:

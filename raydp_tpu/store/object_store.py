@@ -1306,7 +1306,7 @@ def _fetch_chunk(
             view = memoryview(data)
             into[: len(view)] = view
             return len(view)
-        except (ConnectionError, EOFError, _socket.timeout, OSError) as exc:
+        except (EOFError, OSError) as exc:
             if isinstance(exc, FileNotFoundError):
                 # a remote "segment/file is gone" is NOT transient: the
                 # bytes are gone while the head meta survives, and retrying
@@ -1495,7 +1495,7 @@ def get_buffer(ref: ObjectRef, meta: Optional[dict] = None):
         meta = _lookup(ref)
     try:
         return _get_buffer_resolved(ref, meta)
-    except (ClusterError, ConnectionError, OSError) as exc:
+    except (ClusterError, OSError) as exc:
         if isinstance(exc, OwnerDiedError):
             raise
         fresh = _retry_uncached(ref, meta, exc)
@@ -1577,7 +1577,7 @@ def get_arrow_buffer(
         # ranged network pull: only the slice crosses the wire
         try:
             return pa.py_buffer(_remote_fetch(ref, meta, offset, length))
-        except (ClusterError, ConnectionError, OSError) as exc:
+        except (ClusterError, OSError) as exc:
             if isinstance(exc, OwnerDiedError):
                 raise
             fresh = _retry_uncached(ref, meta, exc)

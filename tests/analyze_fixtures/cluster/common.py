@@ -1,0 +1,15 @@
+"""Fixture standing in for cluster/common.py (the rule keys on the path
+suffix): one exception drops its required arg across pickling, one keeps the
+TenantQuotaError contract."""
+
+
+class QuotaExceeded(RuntimeError):
+    def __init__(self, tenant, limit=0):
+        super().__init__(f"over quota (limit={limit})")
+        self.tenant = tenant  # BUG: not in self.args -> lost across pickle
+
+
+class QuotaExceededKept(RuntimeError):
+    def __init__(self, tenant, limit=0):
+        super().__init__(f"tenant {tenant} over quota (limit={limit})")
+        self.tenant = tenant  # in args via the message: survives __reduce__

@@ -1,0 +1,12 @@
+"""Seeded conf-registry violation: a conf key read with no declared default
+at any site — behavior when the key is absent is undefined, and the registry
+cannot document a default that does not exist."""
+
+
+class _Session:
+    def __init__(self, configs):
+        self.configs = configs
+
+    def window_rows(self):
+        # BUG: no default declared anywhere for this key
+        return self.configs.get("etlfx.window_rows")

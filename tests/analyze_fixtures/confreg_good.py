@@ -1,0 +1,14 @@
+"""Fixed conf-registry fixture: the key declares its default at the read
+site (one declaring site is enough — other sites may read bare)."""
+
+
+class _Session:
+    def __init__(self, configs):
+        self.configs = configs
+
+    def window_rows(self):
+        return self.configs.get("etlfx.window_rows", 4096)
+
+    def window_rows_again(self):
+        # a second bare read is fine: the default is declared above
+        return self.configs.get("etlfx.window_rows")

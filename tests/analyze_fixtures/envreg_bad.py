@@ -1,0 +1,7 @@
+"""Seeded env-registry violation: reads a ``RAYDP_TPU_*`` env var no doc
+page mentions (only meaningful in a full-surface sweep — the test loads this
+next to the real package + bench so the closure check runs)."""
+
+import os
+
+FIXTURE_FLAG = os.environ.get("RAYDP_TPU_ETLFX_FIXTURE_FLAG", "0")

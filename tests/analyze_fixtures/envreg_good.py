@@ -1,0 +1,6 @@
+"""Fixed env-registry fixture: reads an env var the docs already cover
+(``RAYDP_TPU_TASK_TRACE`` has a knob-table row in docs/observability.md)."""
+
+import os
+
+TASK_TRACE = os.environ.get("RAYDP_TPU_TASK_TRACE", "")

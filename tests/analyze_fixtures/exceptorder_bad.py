@@ -1,0 +1,33 @@
+"""Seeded except-order violations: divergent sibling cleanup (the
+FileNotFoundError ⊂ OSError pool-poisoning class from the PR 18 postmortem),
+a redundant tuple member, and a handler shadowed by its superclass."""
+
+import socket
+
+
+def fetch(pool, path):
+    sock = pool.lease()
+    try:
+        sock.sendall(path)
+        return sock.recv(1 << 16)
+    except FileNotFoundError:
+        return b""  # BUG: miss path skips the discard — poisons the pool
+    except OSError:
+        pool.discard(sock)
+        raise
+
+
+def connect(addr):
+    try:
+        return socket.create_connection(addr)
+    except (ConnectionError, OSError):  # BUG: ConnectionError ⊆ OSError
+        return None
+
+
+def read_text(path):
+    try:
+        return open(path).read()
+    except OSError:
+        return ""
+    except FileNotFoundError:  # BUG: unreachable behind OSError
+        return None

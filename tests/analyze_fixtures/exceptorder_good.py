@@ -1,0 +1,34 @@
+"""Fixed except-order fixture: the narrow sibling releases the pooled
+socket too, tuples carry no subsumed members, and narrower handlers come
+first."""
+
+import socket
+
+
+def fetch(pool, path):
+    sock = pool.lease()
+    try:
+        sock.sendall(path)
+        return sock.recv(1 << 16)
+    except FileNotFoundError:
+        pool.discard(sock)
+        return b""
+    except OSError:
+        pool.discard(sock)
+        raise
+
+
+def connect(addr):
+    try:
+        return socket.create_connection(addr)
+    except OSError:
+        return None
+
+
+def read_text(path):
+    try:
+        return open(path).read()
+    except FileNotFoundError:
+        return None
+    except OSError:
+        return ""

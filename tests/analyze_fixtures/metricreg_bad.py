@@ -1,0 +1,17 @@
+"""Seeded metric-registry violation: the reporter reads a metric name no
+instrumentation site emits — the write site was renamed, the reader was not,
+and it now steers on zeros forever."""
+
+
+class _Pipeline:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def run(self, ns):
+        self.metrics.counter("etlfx.rows_ingested").inc()
+        self.metrics.counter(f"tenant.{ns}.etlfx_rows").inc(2)
+        self.metrics.histogram("etlfx.stage_ms").observe(12.5)
+
+    def report(self):
+        # BUG: the writer says rows_ingested
+        return self.metrics.counter("etlfx.rows_ingest").value

@@ -1,0 +1,19 @@
+"""Fixed metric-registry fixture: every read resolves to a writer — the
+dynamic ``tenant.<ns>.`` prefix unifies via a segment wildcard and the
+``.p99`` fan-out suffix strips back to the histogram that produces it."""
+
+
+class _Pipeline:
+    def __init__(self, metrics):
+        self.metrics = metrics
+
+    def run(self, ns):
+        self.metrics.counter("etlfx.rows_ingested").inc()
+        self.metrics.counter(f"tenant.{ns}.etlfx_rows").inc(2)
+        self.metrics.histogram("etlfx.stage_ms").observe(12.5)
+
+    def report(self, ns):
+        rows = self.metrics.counter("etlfx.rows_ingested").value
+        tenant_rows = self.metrics.counter(f"tenant.{ns}.etlfx_rows").value
+        p99 = self.metrics.gauge("etlfx.stage_ms.p99").value
+        return rows, tenant_rows, p99

@@ -1,0 +1,15 @@
+"""Seeded rpc-error-safety violation: an RPC-served op raises an exception
+type defined outside cluster/common.py — the client process unpickling the
+("err", exc) payload may not import this module, so the error path itself
+raises ModuleNotFoundError and eats the real failure."""
+# raydp-lint: rpc-surface
+
+
+class FetchPlanError(RuntimeError):
+    """Defined HERE, not in cluster/common.py."""
+
+
+def handle_fetch(op):
+    if op is None:
+        raise FetchPlanError("no plan attached")  # BUG: client can't unpickle
+    raise ValueError("malformed op")  # builtin: survives any process

@@ -1,0 +1,15 @@
+"""Fixed rpc-error-safety fixture: an RPC-served op raises only builtins,
+re-raises bare, or raises types imported from outside the analyzed project
+(opaque — never flagged)."""
+# raydp-lint: rpc-surface
+
+from some_external_sdk import ExternalError  # noqa: F401  (not in project)
+
+
+def handle_fetch(op):
+    try:
+        if op is None:
+            raise TimeoutError("no plan attached")
+        raise ExternalError("upstream said no")
+    except TimeoutError:
+        raise

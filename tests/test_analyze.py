@@ -142,6 +142,265 @@ def test_print_diagnostics_catches_seed():
     assert "print()" in kinds and "print_exc" in kinds
 
 
+def test_metric_registry_catches_seed():
+    """Read-without-writer: the reporter reads `etlfx.rows_ingest` but the
+    instrumentation site says `etlfx.rows_ingested`."""
+    found = run_rule("metric-registry", "metricreg_bad.py")
+    assert len(found) == 1
+    assert "etlfx.rows_ingest" in found[0].message
+    assert "nobody writes" in found[0].message
+
+
+def test_metric_registry_clean_on_fixed():
+    """Dynamic `tenant.<ns>.` reads and `.p99` fan-out reads resolve to
+    their writers — no false positives on the fixed fixture."""
+    assert run_rule("metric-registry", "metricreg_good.py") == []
+
+
+def test_conf_registry_catches_seed():
+    found = run_rule("conf-registry", "confreg_bad.py")
+    assert len(found) == 1
+    assert "etlfx.window_rows" in found[0].message
+    assert "no explicit default" in found[0].message
+
+
+def test_conf_registry_clean_on_fixed():
+    """One declaring site is enough — the second bare read of the same key
+    is not flagged."""
+    assert run_rule("conf-registry", "confreg_good.py") == []
+
+
+def test_env_registry_catches_seed():
+    """env-registry runs only on full-surface sweeps (package + bench in
+    scope): the fixture's undocumented RAYDP_TPU_ETLFX_FIXTURE_FLAG read is
+    the single finding against the real docs tree."""
+    from tools.analyze.__main__ import config_excludes
+
+    project = load_project(
+        [
+            os.path.join(REPO_ROOT, "raydp_tpu"),
+            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(REPO_ROOT, "tests", "conftest.py"),
+            os.path.join(FIXTURES, "envreg_bad.py"),
+        ],
+        root=REPO_ROOT,
+        exclude=config_excludes(REPO_ROOT),
+    )
+    findings = run_rules(project, [rules_by_name()["env-registry"]()])
+    active = [f for f in findings if not f.suppressed]
+    assert len(active) == 1, "\n".join(f.render() for f in active)
+    assert "RAYDP_TPU_ETLFX_FIXTURE_FLAG" in active[0].message
+
+
+def test_env_registry_clean_on_fixed():
+    from tools.analyze.__main__ import config_excludes
+
+    project = load_project(
+        [
+            os.path.join(REPO_ROOT, "raydp_tpu"),
+            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(REPO_ROOT, "tests", "conftest.py"),
+            os.path.join(FIXTURES, "envreg_good.py"),
+        ],
+        root=REPO_ROOT,
+        exclude=config_excludes(REPO_ROOT),
+    )
+    findings = run_rules(project, [rules_by_name()["env-registry"]()])
+    active = [f for f in findings if not f.suppressed]
+    assert active == [], "\n".join(f.render() for f in active)
+
+
+def test_env_registry_skips_partial_sweeps():
+    """Without the full-surface markers in scope the rule stays silent — a
+    one-file sweep must not demand the docs describe it."""
+    assert run_rule("env-registry", "envreg_bad.py") == []
+
+
+def test_rpc_error_safety_catches_seed():
+    found = run_rule("rpc-error-safety", "rpcerr_bad.py")
+    assert len(found) == 1
+    assert "FetchPlanError" in found[0].message
+    assert "unpickling" in found[0].message
+
+
+def test_rpc_error_safety_clean_on_fixed():
+    """Builtins, bare re-raises, and types imported from outside the project
+    are all fine inside an RPC-served file."""
+    assert run_rule("rpc-error-safety", "rpcerr_good.py") == []
+
+
+def test_rpc_error_safety_pickle_contract():
+    """The cluster/common.py half: a required __init__ arg not forwarded to
+    super().__init__ is lost across BaseException.__reduce__ (the
+    TenantQuotaError.tenant contract); forwarding through the message
+    f-string satisfies it."""
+    found = run_rule("rpc-error-safety", os.path.join("cluster", "common.py"))
+    assert len(found) == 1
+    assert "QuotaExceeded" in found[0].message
+    assert "tenant" in found[0].message
+
+
+def test_except_order_catches_seed():
+    found = run_rule("except-order", "exceptorder_bad.py")
+    messages = "\n".join(f.message for f in found)
+    assert len(found) == 3
+    # divergent cleanup: the narrow miss path never discards the socket
+    assert "never touches `sock`" in messages
+    # redundant tuple member
+    assert "`ConnectionError` is redundant" in messages
+    # unreachable handler behind its superclass
+    assert "unreachable" in messages and "FileNotFoundError ⊆ OSError" in messages
+
+
+def test_except_order_clean_on_fixed():
+    assert run_rule("except-order", "exceptorder_good.py") == []
+
+
+# ---------------------------------------------------------------------------
+# white-box: the shared surface-extraction pass
+# ---------------------------------------------------------------------------
+
+
+def test_surfaces_dynamic_tenant_prefix_resolves():
+    """f-string holes become single-segment wildcards: the write pattern
+    `tenant.<*>.etlfx_rows` unifies with any concrete tenant read."""
+    from tools.analyze.surfaces import patterns_match
+
+    project = load_project([os.path.join(FIXTURES, "metricreg_good.py")])
+    surf = project.surfaces()
+    assert "tenant.<*>.etlfx_rows" in surf.write_patterns()
+    assert patterns_match("tenant.dashboards.etlfx_rows",
+                          "tenant.<*>.etlfx_rows")
+    assert not patterns_match("tenant.a.b.etlfx_rows",
+                              "tenant.<*>.etlfx_rows")  # one segment only
+
+
+def test_surfaces_fanout_suffix_strips_to_instrument():
+    """`etlfx.stage_ms.p99` is a fan-out series of the histogram — the read
+    resolves to the instrumentation site, no false positive."""
+    from tools.analyze.surfaces import strip_fanout
+
+    project = load_project([os.path.join(FIXTURES, "metricreg_good.py")])
+    surf = project.surfaces()
+    assert strip_fanout("etlfx.stage_ms.p99") == "etlfx.stage_ms"
+    assert strip_fanout("etlfx.stage_ms") == "etlfx.stage_ms"
+    assert surf.has_writer("etlfx.stage_ms.p99")
+
+
+def test_surfaces_read_without_writer_detected():
+    """The typo'd read has no producer even though its family has writers in
+    scope — exactly the condition the metric-registry rule gates on."""
+    project = load_project([os.path.join(FIXTURES, "metricreg_bad.py")])
+    surf = project.surfaces()
+    assert "etlfx" in surf.write_families()
+    assert not surf.has_writer("etlfx.rows_ingest")
+    assert surf.has_writer("etlfx.rows_ingested")
+
+
+def test_metric_registry_mutation_check():
+    """The acceptance-criteria drill: rename `serve.p99_ms` at its
+    batcher.py instrumentation site and metric-registry must fail the build
+    from three directions — the doc row goes dead, the autoscaler's reads go
+    writerless, and the renamed write is undocumented."""
+    from tools.analyze.__main__ import config_excludes
+    from tools.analyze.core import Project, SourceFile
+
+    project = load_project(
+        [
+            os.path.join(REPO_ROOT, "raydp_tpu"),
+            os.path.join(REPO_ROOT, "tools"),
+            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(REPO_ROOT, "examples"),
+            os.path.join(REPO_ROOT, "tests", "conftest.py"),
+        ],
+        root=REPO_ROOT,
+        exclude=config_excludes(REPO_ROOT),
+    )
+    target = os.path.join("raydp_tpu", "serve", "batcher.py")
+    src = project.file(target)
+    assert src is not None and '"serve.p99_ms"' in src.text
+    mutated = SourceFile(
+        src.path, src.display_path,
+        src.text.replace('"serve.p99_ms"', '"serve.p99_millis"'),
+    )
+    files = [mutated if f.display_path == target else f for f in project.files]
+    findings = run_rules(
+        Project(files, root=REPO_ROOT),
+        [rules_by_name()["metric-registry"]()],
+    )
+    active = [f for f in findings if not f.suppressed]
+    rendered = "\n".join(f.render() for f in active)
+    assert any("docs row describes metric `serve.p99_ms`" in f.message
+               for f in active), rendered
+    assert any("`serve.p99_ms` is read here" in f.message
+               for f in active), rendered
+    assert any("`serve.p99_millis` is instrumented here" in f.message
+               for f in active), rendered
+
+
+# ---------------------------------------------------------------------------
+# suppression budget gate
+# ---------------------------------------------------------------------------
+
+
+def test_suppression_stats_counts_by_rule(tmp_path):
+    from tools.analyze.__main__ import suppression_stats
+
+    path = tmp_path / "sup.py"
+    path.write_text(
+        "print('a')  # raydp-lint: disable=print-diagnostics (x)\n"
+        "print('b')  # raydp-lint: disable=print-diagnostics (y)\n"
+        "print('c')\n"
+    )
+    findings = run_rules(
+        load_project([str(path)]), [rules_by_name()["print-diagnostics"]()]
+    )
+    assert suppression_stats(findings) == {"print-diagnostics": 2}
+
+
+def test_check_budget_flags_growth_only(tmp_path):
+    from tools.analyze.__main__ import check_budget
+
+    budget = tmp_path / "budget.json"
+    budget.write_text('{"print-diagnostics": 2, "swallowed-exceptions": 5}\n')
+    # within budget (and below budget elsewhere): clean
+    assert check_budget({"print-diagnostics": 2}, str(budget)) == []
+    assert check_budget({"swallowed-exceptions": 3}, str(budget)) == []
+    # growth fails, naming the rule and the budget file
+    problems = check_budget({"print-diagnostics": 3}, str(budget))
+    assert len(problems) == 1 and "print-diagnostics" in problems[0]
+    # a rule absent from the budget has an implicit budget of zero
+    problems = check_budget({"guarded-by": 1}, str(budget))
+    assert len(problems) == 1 and "guarded-by" in problems[0]
+    # missing budget file is itself a failure with a remedy
+    problems = check_budget({}, str(tmp_path / "nope.json"))
+    assert len(problems) == 1 and "--write-budget" in problems[0]
+
+
+def test_repo_suppressions_within_budget():
+    """The committed budget covers the CI sweep exactly: no rule suppresses
+    more than tools/analyze/suppression_budget.json allows."""
+    from tools.analyze.__main__ import (
+        BUDGET_FILE, check_budget, config_excludes, suppression_stats,
+    )
+
+    project = load_project(
+        [
+            os.path.join(REPO_ROOT, "raydp_tpu"),
+            os.path.join(REPO_ROOT, "tools"),
+            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(REPO_ROOT, "examples"),
+            os.path.join(REPO_ROOT, "tests", "conftest.py"),
+        ],
+        root=REPO_ROOT,
+        exclude=config_excludes(REPO_ROOT),
+    )
+    findings = run_rules(project, [cls() for cls in ALL_RULES])
+    stats = suppression_stats(findings)
+    problems = check_budget(stats, os.path.join(REPO_ROOT, BUDGET_FILE))
+    assert problems == [], "\n".join(problems)
+
+
 # ---------------------------------------------------------------------------
 # suppression mechanics + report contract
 # ---------------------------------------------------------------------------
@@ -285,14 +544,17 @@ def test_fixture_dir_excluded_via_config():
 
 def test_repo_is_lint_clean():
     """The exact invocation CI gates on: every finding in raydp_tpu/, the
-    self-hosted tools/ tree, and tests/conftest.py carries an explicit
-    suppression."""
+    self-hosted tools/ tree, bench.py, examples/, and tests/conftest.py
+    carries an explicit suppression — with the full-surface registry rules
+    (metric/conf/env closure) and exception-flow rules active."""
     from tools.analyze.__main__ import config_excludes
 
     project = load_project(
         [
             os.path.join(REPO_ROOT, "raydp_tpu"),
             os.path.join(REPO_ROOT, "tools"),
+            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(REPO_ROOT, "examples"),
             os.path.join(REPO_ROOT, "tests", "conftest.py"),
         ],
         root=REPO_ROOT,

@@ -10,17 +10,65 @@ gate is ``python -m tools.analyze raydp_tpu/ tools/ tests/conftest.py``
 fnmatch pattern against the repo-relative path; default exclusions come from
 ``setup.cfg``'s ``[raydp-lint] exclude`` (the seeded-violation fixtures under
 tests/analyze_fixtures/ live there, not as a hardcoded path check).
+
+``--stats`` prints per-rule suppression counts; ``--write-budget`` commits
+them to ``tools/analyze/suppression_budget.json``; ``--check-budget`` fails
+when any rule suppresses more than its budgeted count — so a new suppression
+only lands together with an explicit budget-file change in the same diff.
 """
 
 from __future__ import annotations
 
 import argparse
 import configparser
+import json
 import os
 import sys
+from collections import Counter
 
 from tools.analyze.core import load_project, render_report, run_rules
 from tools.analyze.rules import ALL_RULES, rules_by_name
+
+#: Committed per-rule suppression counts (repo-relative). CI runs
+#: ``--check-budget``: a suppression count may only grow when the same diff
+#: updates this file — an explicit, reviewable act, never drift.
+BUDGET_FILE = os.path.join("tools", "analyze", "suppression_budget.json")
+
+
+def suppression_stats(findings) -> dict:
+    """Per-rule count of SUPPRESSED findings, sorted by rule name."""
+    counts = Counter(f.rule for f in findings if f.suppressed)
+    return dict(sorted(counts.items()))
+
+
+def check_budget(stats: dict, budget_path: str) -> list:
+    """Lines describing budget violations (empty = within budget).
+
+    Only growth fails: a rule suppressing MORE than its budgeted count means
+    someone added a suppression without touching the committed budget. Counts
+    below budget are fine (the ratchet is tightened by re-running
+    ``--write-budget``, a separate deliberate act).
+    """
+    try:
+        with open(budget_path, encoding="utf-8") as f:
+            budget = json.load(f)
+    except FileNotFoundError:
+        return [
+            f"suppression budget file missing: {budget_path} "
+            "(create it with --write-budget)"
+        ]
+    except (OSError, ValueError) as exc:
+        return [f"unreadable suppression budget {budget_path}: {exc}"]
+    problems = []
+    for rule, count in stats.items():
+        allowed = budget.get(rule, 0)
+        if count > allowed:
+            problems.append(
+                f"{rule}: {count} suppression(s), budget allows {allowed} — "
+                "remove the new suppression or update "
+                f"{os.path.relpath(budget_path)} in the same change"
+            )
+    return problems
 
 
 def find_root(paths) -> str:
@@ -81,6 +129,19 @@ def main(argv=None) -> int:
     parser.add_argument(
         "--list-rules", action="store_true", help="list rules and exit"
     )
+    parser.add_argument(
+        "--stats", action="store_true",
+        help="emit per-rule suppression counts instead of findings",
+    )
+    parser.add_argument(
+        "--write-budget", action="store_true",
+        help=f"write per-rule suppression counts to {BUDGET_FILE}",
+    )
+    parser.add_argument(
+        "--check-budget", action="store_true",
+        help="fail if any rule's suppression count exceeds the committed "
+        f"budget in {BUDGET_FILE}",
+    )
     args = parser.parse_args(argv)
 
     registry = rules_by_name()
@@ -111,6 +172,34 @@ def main(argv=None) -> int:
     exclude = config_excludes(root) + list(args.exclude)
     project = load_project(args.paths, root=root, exclude=exclude)
     findings = run_rules(project, rules)
+
+    if args.stats or args.write_budget or args.check_budget:
+        stats = suppression_stats(findings)
+        budget_path = os.path.join(root, BUDGET_FILE)
+        if args.stats:
+            if args.json:
+                sys.stdout.write(json.dumps(stats, indent=2) + "\n")
+            else:
+                for rule, count in stats.items():
+                    sys.stdout.write(f"{rule}: {count}\n")
+                sys.stdout.write(
+                    f"raydp-lint: {sum(stats.values())} suppression(s) "
+                    f"across {len(stats)} rule(s)\n"
+                )
+        if args.write_budget:
+            with open(budget_path, "w", encoding="utf-8") as f:
+                json.dump(stats, f, indent=2)
+                f.write("\n")
+            sys.stdout.write(f"wrote {os.path.relpath(budget_path)}\n")
+        if args.check_budget:
+            problems = check_budget(stats, budget_path)
+            for line in problems:
+                sys.stderr.write(line + "\n")
+            if problems:
+                return 1
+            sys.stdout.write("raydp-lint: suppression counts within budget\n")
+        return 0
+
     report, code = render_report(findings, as_json=args.json)
     sys.stdout.write(report + "\n")
     return code

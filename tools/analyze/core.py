@@ -89,7 +89,7 @@ class SourceFile:
                     before = self.lines[tok.start[0] - 1][: tok.start[1]]
                     if not before.strip():
                         comment_only_lines.add(tok.start[0])
-        except (tokenize.TokenError, IndentationError, SyntaxError):
+        except (tokenize.TokenError, SyntaxError):
             # fall back to a line regex; strings containing the marker would
             # be miscounted, but an untokenizable file rarely has any
             comments = [
@@ -155,12 +155,24 @@ class SourceFile:
 
 
 class Project:
-    def __init__(self, files: Sequence[SourceFile]):
+    def __init__(self, files: Sequence[SourceFile], root: Optional[str] = None):
         self.files = list(files)
+        self.root = root or os.getcwd()
         self._by_path = {f.display_path: f for f in self.files}
+        self._surfaces = None
 
     def file(self, display_path: str) -> Optional[SourceFile]:
         return self._by_path.get(display_path)
+
+    def surfaces(self):
+        """Memoized whole-surface registry (see :mod:`tools.analyze.surfaces`):
+        metric/conf/env read+write sites plus doc table rows. Shared by the
+        registry-closure rules so the project is walked once, not per rule."""
+        if self._surfaces is None:
+            from tools.analyze import surfaces as _surf
+
+            self._surfaces = _surf.extract(self, self.root)
+        return self._surfaces
 
     def __iter__(self):
         return iter(self.files)
@@ -227,7 +239,7 @@ def load_project(
             sys.stderr.write(f"raydp-lint: cannot read {path}: {exc}\n")
             continue
         files.append(SourceFile(path, display, text))
-    return Project(files)
+    return Project(files, root=root)
 
 
 def run_rules(project: Project, rules) -> List[Finding]:

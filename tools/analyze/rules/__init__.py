@@ -2,10 +2,15 @@
 import line here (see docs/analysis.md "Adding a checker")."""
 
 from tools.analyze.rules.blocking_under_lock import BlockingUnderLockRule
+from tools.analyze.rules.conf_registry import ConfRegistryRule
 from tools.analyze.rules.donation_aliasing import DonationAliasingRule
+from tools.analyze.rules.env_registry import EnvRegistryRule
+from tools.analyze.rules.except_order import ExceptOrderRule
 from tools.analyze.rules.guarded_by import GuardedByRule
 from tools.analyze.rules.lock_order import LockOrderRule
+from tools.analyze.rules.metric_registry import MetricRegistryRule
 from tools.analyze.rules.print_diagnostics import PrintDiagnosticsRule
+from tools.analyze.rules.rpc_error_safety import RpcErrorSafetyRule
 from tools.analyze.rules.rpc_protocol import RpcProtocolRule
 from tools.analyze.rules.swallowed_exceptions import SwallowedExceptionsRule
 
@@ -17,6 +22,11 @@ ALL_RULES = (
     LockOrderRule,
     BlockingUnderLockRule,
     PrintDiagnosticsRule,
+    MetricRegistryRule,
+    ConfRegistryRule,
+    EnvRegistryRule,
+    RpcErrorSafetyRule,
+    ExceptOrderRule,
 )
 
 

@@ -1,0 +1,103 @@
+"""conf-registry: every conf key read has a declared default and a doc row.
+
+The conf surface is string-keyed (`configs.get("etl.fuse_stages", ...)`,
+serve/config.py's ``get("max_batch_size", 8)`` wrapper, session.py's
+``_flag``): a typo'd key silently yields the fallback, and a key with *no*
+fallback is a latent KeyError/None in a remote process. Checks:
+
+- **no-default** — a conf read site passes no explicit default and the
+  wrapper it goes through declares none either.
+- **undocumented-key** — a key read in code has no row in any docs conf
+  table (full-surface sweeps only).
+- **dead-doc-key** — a documented key no code reads: usually a rename that
+  forgot the docs table (full-surface only). Env-var rows and metric rows in
+  mixed tables are excluded by shape.
+
+Docs-side findings suppress via ``<!-- raydp-lint: disable=conf-registry -->``
+on the row.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.analyze.core import Finding, Project
+
+# keys that are forwarded verbatim to an external system (spark-compat
+# passthrough namespaces) — documented behavior is "whatever the engine
+# does", so closure is not ours to check
+_PASSTHROUGH_PREFIXES = ("spark.",)
+
+
+def _passthrough(key: str) -> bool:
+    return key.startswith(_PASSTHROUGH_PREFIXES)
+
+
+class ConfRegistryRule:
+    name = "conf-registry"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        surf = project.surfaces()
+        findings: List[Finding] = []
+
+        def code_finding(read, message: str) -> None:
+            src = project.file(read.path)
+            if src is not None:
+                findings.append(src.finding(self.name, read.line, message))
+            else:
+                findings.append(
+                    Finding(self.name, read.path, read.line, 0, message)
+                )
+
+        doc_keys = surf.doc_conf_keys()
+        read_keys = surf.conf_keys()
+        # a key is "defaulted" if ANY read site declares a default — one
+        # canonical read with a default plus bare re-reads elsewhere is the
+        # repo's normal shape
+        defaulted = {c.key for c in surf.conf_reads if c.has_default}
+
+        seen = set()
+        for read in surf.conf_reads:
+            if _passthrough(read.key):
+                continue
+            site = (read.key, read.path, read.line)
+            if site in seen:
+                continue
+            seen.add(site)
+            if read.key not in defaulted:
+                code_finding(
+                    read,
+                    f"conf key `{read.key}` is read with no explicit default "
+                    "at any site — a missing key becomes None/KeyError in a "
+                    "remote process; declare the default here",
+                )
+                defaulted.add(read.key)  # one finding per key, not per site
+            if surf.full_surface and read.key not in doc_keys:
+                code_finding(
+                    read,
+                    f"conf key `{read.key}` has no row in any docs conf "
+                    "table — add it to the owning page's knob table",
+                )
+                doc_keys.add(read.key)  # one finding per key
+
+        if surf.full_surface:
+            env_doc_names = {d.name for d in surf.doc_envs}
+            for entry in surf.doc_confs:
+                if entry.name in read_keys or _passthrough(entry.name):
+                    continue
+                if entry.name in env_doc_names:
+                    continue  # env row in a mixed knob table
+                doc = surf.doc_files.get(entry.path)
+                suppressed = bool(
+                    doc and doc.is_suppressed(self.name, entry.line)
+                )
+                findings.append(
+                    Finding(
+                        self.name, entry.path, entry.line, 0,
+                        f"docs table documents conf key `{entry.name}` but "
+                        "no code reads it — stale rename or dead knob",
+                        suppressed=suppressed,
+                    )
+                )
+
+        return findings

@@ -1,0 +1,73 @@
+"""env-registry: closure over the ``RAYDP_TPU_*`` environment surface.
+
+Env vars are the widest-reaching knobs in the system — they cross process
+boundaries (head -> zygote -> worker env dicts) and are set by operators who
+only have the docs. Checks:
+
+- **undocumented-env** — a ``RAYDP_TPU_*`` var read in code (os.getenv /
+  environ.get / ``in os.environ`` / module-level ``FOO_ENV = "RAYDP_TPU_X"``
+  constants resolved project-wide) is never mentioned in backticks anywhere
+  under ``docs/`` or README.md. Full-surface sweeps only.
+- **dead-env-doc** — a var documented in a docs table that no code reads
+  *or* sets: stale rename. (Set-only vars are fine — spawners export vars
+  their children read; doc rows for them are the contract.)
+
+Inline backticked mentions count as documentation — the bar is "an operator
+grepping the docs finds it", not "it is in one specific table".
+Suppress doc-side findings with ``<!-- raydp-lint: disable=env-registry -->``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.analyze.core import Finding, Project
+
+
+class EnvRegistryRule:
+    name = "env-registry"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        surf = project.surfaces()
+        findings: List[Finding] = []
+        if not surf.full_surface:
+            return findings
+
+        documented = {d.name for d in surf.doc_envs}
+        read_names = {e.name for e in surf.env_reads}
+        set_names = {e.name for e in surf.env_sets}
+
+        reported = set()
+        for use in surf.env_reads:
+            if use.name in documented or use.name in reported:
+                continue
+            reported.add(use.name)
+            src = project.file(use.path)
+            msg = (
+                f"env var `{use.name}` is read here but never documented — "
+                "mention it (backticked) in the owning docs page so "
+                "operators can find it"
+            )
+            if src is not None:
+                findings.append(src.finding(self.name, use.line, msg))
+            else:
+                findings.append(Finding(self.name, use.path, use.line, 0, msg))
+
+        seen_doc = set()
+        for entry in surf.doc_envs:
+            if entry.name in read_names or entry.name in set_names:
+                continue
+            if entry.name in seen_doc:
+                continue
+            seen_doc.add(entry.name)
+            doc = surf.doc_files.get(entry.path)
+            suppressed = bool(doc and doc.is_suppressed(self.name, entry.line))
+            findings.append(
+                Finding(
+                    self.name, entry.path, entry.line, 0,
+                    f"docs mention env var `{entry.name}` but no code reads "
+                    "or sets it — stale rename or dead knob",
+                    suppressed=suppressed,
+                )
+            )
+        return findings

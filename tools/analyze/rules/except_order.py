@@ -1,0 +1,281 @@
+"""except-order: handler-chain flow checks over the exception hierarchy.
+
+PR 18's postmortem: ``service_block_fetch`` handled ``OSError`` (release the
+pooled socket) but a later ``FileNotFoundError`` miss path returned early
+without the release — ``FileNotFoundError ⊂ OSError`` and each miss poisoned
+one pooled connection. Three structural checks:
+
+- **shadowed-handler** — ``except B`` before ``except A`` where ``A ⊆ B``:
+  the second handler is unreachable (a bare/``Exception`` handler earlier in
+  the chain shadows every later one).
+- **redundant-tuple-member** — ``except (A, B)`` where ``A ⊆ B``: the
+  narrower member is dead weight and usually betrays a wrong mental model of
+  the hierarchy (``socket.timeout`` *is* ``TimeoutError`` *is* ``OSError``).
+- **divergent-cleanup** — sibling handlers where the narrow one
+  (``FileNotFoundError``) reaches a resource-bearing try body but skips a
+  cleanup call (``close``/``release``/``discard``/...) that the broad
+  sibling (``OSError``) performs on a name the try body uses. The narrow
+  handler intercepts a subset of the broad one's exceptions, so the cleanup
+  silently stops happening for exactly those cases.
+
+Types are resolved through builtins, the stdlib alias table
+(``socket.timeout`` -> ``TimeoutError``, ``socket.error``/``IOError`` ->
+``OSError``), and project-defined exception classes (base chains walked to a
+builtin). Unresolvable types are opaque: never flagged.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import Finding, Project
+
+# stdlib names that are aliases of (or subclasses folded into) builtins
+_ALIASES = {
+    "timeout": "TimeoutError",     # socket.timeout
+    "error": "OSError",            # socket.error
+    "gaierror": "OSError",
+    "herror": "OSError",
+    "IOError": "OSError",
+    "EnvironmentError": "OSError",
+    "WindowsError": "OSError",
+}
+
+_CLEANUP_METHODS = {
+    "close", "release", "discard", "unlink", "remove", "shutdown",
+    "terminate", "kill", "cleanup", "rollback", "abort",
+}
+
+
+def _type_name(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    return None
+
+
+class _Hierarchy:
+    """Subclass queries over builtins + project exception classes."""
+
+    def __init__(self, project: Project):
+        self.bases: Dict[str, List[str]] = {}
+        for src in project:
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, ast.ClassDef):
+                    names = [
+                        n for n in (_type_name(b) for b in node.bases) if n
+                    ]
+                    if names:
+                        self.bases.setdefault(node.name, names)
+
+    def _builtin(self, name: str) -> Optional[type]:
+        name = _ALIASES.get(name, name)
+        obj = getattr(builtins, name, None)
+        if isinstance(obj, type) and issubclass(obj, BaseException):
+            return obj
+        return None
+
+    def _ancestors(self, name: str, seen: Optional[Set[str]] = None) -> Set[str]:
+        seen = seen if seen is not None else set()
+        if name in seen:
+            return seen
+        seen.add(name)
+        for base in self.bases.get(name, ()):
+            self._ancestors(base, seen)
+        return seen
+
+    def is_known(self, name: str) -> bool:
+        return self._builtin(name) is not None or name in self.bases
+
+    def is_subtype(self, a: str, b: str) -> bool:
+        """Conservative: True only when provably a ⊆ b."""
+        if a == b and self.is_known(a):
+            return True
+        bb = self._builtin(b)
+        ab = self._builtin(a)
+        if ab is not None and bb is not None:
+            return issubclass(ab, bb)
+        if a in self.bases:
+            anc = self._ancestors(a)
+            if b in anc:
+                return True
+            if bb is not None:
+                for ancestor in anc:
+                    anb = self._builtin(ancestor)
+                    if anb is not None and issubclass(anb, bb):
+                        return True
+        return False
+
+
+def _handler_types(handler: ast.ExceptHandler) -> List[Tuple[str, ast.AST]]:
+    """(name, node) per caught type; [("<bare>", handler)] for ``except:``."""
+    if handler.type is None:
+        return [("<bare>", handler)]
+    if isinstance(handler.type, ast.Tuple):
+        out = []
+        for elt in handler.type.elts:
+            n = _type_name(elt)
+            if n:
+                out.append((n, elt))
+        return out
+    n = _type_name(handler.type)
+    return [(n, handler.type)] if n else []
+
+
+def _names_used(node: ast.AST) -> Set[str]:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _cleanup_receivers(body: List[ast.stmt]) -> Set[str]:
+    """Root names whose attributes get cleanup calls (``sock.close()``,
+    ``self._pool.discard(sock)`` -> {sock, self})."""
+    out: Set[str] = set()
+    for stmt in body:
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _CLEANUP_METHODS
+            ):
+                root = node.func.value
+                while isinstance(root, ast.Attribute):
+                    root = root.value
+                if isinstance(root, ast.Name):
+                    out.add(root.id)
+                # args to pool.discard(sock) also name the resource
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        out.add(arg.id)
+    return out
+
+
+class ExceptOrderRule:
+    name = "except-order"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        hier = _Hierarchy(project)
+        findings: List[Finding] = []
+        for src in project:
+            if src.tree is None:
+                continue
+            for node in ast.walk(src.tree):
+                if isinstance(node, (ast.Try, getattr(ast, "TryStar", ast.Try))):
+                    self._check_try(src, node, hier, findings)
+        return findings
+
+    def _check_try(self, src, node, hier: _Hierarchy, findings: List[Finding]):
+        handlers = getattr(node, "handlers", [])
+        if not handlers:
+            return
+
+        # ---- redundant tuple members
+        for handler in handlers:
+            if not isinstance(handler.type, ast.Tuple):
+                continue
+            types = _handler_types(handler)
+            for i, (a, a_node) in enumerate(types):
+                for j, (b, _) in enumerate(types):
+                    if i == j:
+                        continue
+                    if a == b:
+                        redundant = i > j  # duplicate: flag the later copy
+                    else:
+                        redundant = hier.is_subtype(a, b)
+                    if redundant:
+                        findings.append(
+                            src.finding(
+                                self.name, a_node,
+                                f"`{a}` is redundant in this tuple — it is "
+                                f"already caught as `{b}`"
+                                + (
+                                    ""
+                                    if a == b
+                                    else f" ({a} ⊆ {b})"
+                                ),
+                            )
+                        )
+                        break
+
+        # ---- shadowed handlers across the chain
+        prior: List[Tuple[str, ast.ExceptHandler]] = []
+        for handler in handlers:
+            types = _handler_types(handler)
+            for tname, tnode in types:
+                if tname == "<bare>":
+                    continue
+                for (pname, _ph) in prior:
+                    if pname == "<bare>" or hier.is_subtype(tname, pname):
+                        findings.append(
+                            src.finding(
+                                self.name, tnode,
+                                f"handler for `{tname}` is unreachable — an "
+                                "earlier handler already catches "
+                                + (
+                                    "everything (bare except)"
+                                    if pname == "<bare>"
+                                    else f"`{pname}` ({tname} ⊆ {pname})"
+                                ),
+                            )
+                        )
+                        break
+                else:
+                    continue
+                break
+            prior.extend((t, handler) for t, _ in types)
+
+        # ---- divergent cleanup between overlapping siblings
+        try_resources = _names_used_in_body(node.body)
+        for i, narrow in enumerate(handlers):
+            for broad in handlers[i + 1:]:
+                self._check_divergent(
+                    src, narrow, broad, hier, try_resources, findings
+                )
+
+    def _check_divergent(
+        self, src, narrow, broad, hier, try_resources, findings
+    ):
+        narrow_types = [t for t, _ in _handler_types(narrow)]
+        broad_types = [t for t, _ in _handler_types(broad)]
+        overlap = any(
+            nt != "<bare>"
+            and (bt == "<bare>" or (nt != bt and hier.is_subtype(nt, bt)))
+            for nt in narrow_types
+            for bt in broad_types
+        )
+        if not overlap:
+            return
+        broad_cleans = _cleanup_receivers(broad.body)
+        # only resources the try body itself manipulates count — cleaning
+        # self-state is the handler's own business
+        relevant = {
+            r for r in broad_cleans if r in try_resources and r != "self"
+        }
+        if not relevant:
+            return
+        narrow_names = _names_used(narrow)
+        missed = sorted(r for r in relevant if r not in narrow_names)
+        if not missed:
+            return
+        caught = ", ".join(t for t in narrow_types if t != "<bare>")
+        findings.append(
+            src.finding(
+                self.name, narrow,
+                f"handler for `{caught}` intercepts a subset of a later "
+                f"handler's exceptions but never touches `{', '.join(missed)}`"
+                " which that handler cleans up — the narrow path leaks the "
+                "resource (the FileNotFoundError ⊂ OSError pool-poisoning "
+                "class)",
+            )
+        )
+
+
+def _names_used_in_body(body: List[ast.stmt]) -> Set[str]:
+    out: Set[str] = set()
+    for stmt in body:
+        out |= _names_used(stmt)
+    return out

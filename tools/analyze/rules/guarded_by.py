@@ -49,7 +49,7 @@ def _annotations(src: SourceFile) -> Dict[int, Tuple[str, bool]]:
         comments = [
             (t.start[0], t.string) for t in tokens if t.type == tokenize.COMMENT
         ]
-    except (tokenize.TokenError, IndentationError, SyntaxError):
+    except (tokenize.TokenError, SyntaxError):
         comments = [
             (i + 1, line) for i, line in enumerate(src.lines) if "#" in line
         ]

@@ -1,0 +1,118 @@
+"""metric-registry: two-way code<->docs closure over metric names.
+
+PR 17's capacity planner and the serve autoscaler both steer on metric names
+read back out of the registry (`serve.p99_ms`, `mem.pressure`,
+`serve.decode.veto.slots`); rename the instrumentation site and the
+controller silently reads zeros forever. Three checks:
+
+- **undocumented-write** — a metric instrumented in code has no row in any
+  docs metric table (``docs/observability.md`` et al). Only in full-surface
+  sweeps (package + bench in scope), so linting one subdirectory doesn't
+  demand the docs describe it.
+- **dead-doc-row** — a docs metric row matches no instrumentation site: the
+  doc describes a series nobody emits (usually a rename that forgot the
+  docs). Full-surface only.
+- **read-without-writer** — a metric *read* (``.value``/``.quantile``,
+  ``query_metrics``, ledger dict-gets) whose name no instrumentation site
+  can produce. Fan-out suffixes (``.p99``/``.max``/``.delta``...) are
+  stripped before matching; ``tenant.<ns>.``-style dynamic prefixes unify
+  via segment wildcards. Gated on the name's leading family having writers
+  in scope, so partial sweeps and self-contained fixtures work.
+
+Docs-side findings anchor to the markdown row; suppress with an HTML
+comment on that row: ``<!-- raydp-lint: disable=metric-registry -->``.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.analyze.core import Finding, Project
+from tools.analyze.surfaces import patterns_match, strip_fanout
+
+
+class MetricRegistryRule:
+    name = "metric-registry"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        surf = project.surfaces()
+        findings: List[Finding] = []
+
+        def code_finding(use, message: str) -> None:
+            src = project.file(use.path)
+            if src is not None:
+                findings.append(src.finding(self.name, use.line, message))
+            else:
+                findings.append(
+                    Finding(self.name, use.path, use.line, 0, message)
+                )
+
+        def doc_finding(entry, message: str) -> None:
+            doc = surf.doc_files.get(entry.path)
+            suppressed = bool(
+                doc and doc.is_suppressed(self.name, entry.line)
+            )
+            findings.append(
+                Finding(self.name, entry.path, entry.line, 0, message,
+                        suppressed=suppressed)
+            )
+
+        # ---- undocumented-write (full-surface only)
+        if surf.full_surface:
+            reported = set()
+            for w in surf.metric_writes:
+                if w.pattern in reported:
+                    continue
+                if not surf.is_documented_metric(w.pattern):
+                    reported.add(w.pattern)
+                    code_finding(
+                        w,
+                        f"metric `{w.pattern}` is instrumented here but has "
+                        "no row in any docs metric table — document it in "
+                        "docs/observability.md or the owning subsystem page",
+                    )
+
+            # ---- dead-doc-row
+            for entry in surf.doc_metrics:
+                if any(
+                    patterns_match(entry.name, w.pattern)
+                    for w in surf.metric_writes
+                ):
+                    continue
+                # a row may describe a fan-out series of a real instrument
+                base = strip_fanout(entry.name)
+                if base != entry.name and any(
+                    patterns_match(base, w.pattern)
+                    for w in surf.metric_writes
+                ):
+                    continue
+                doc_finding(
+                    entry,
+                    f"docs row describes metric `{entry.name}` but no "
+                    "instrumentation site emits it — stale rename or dead "
+                    "series; fix the name or drop the row",
+                )
+
+        # ---- read-without-writer
+        families = surf.write_families()
+        seen_reads = set()
+        for r in list(surf.metric_reads) + list(surf.metric_mentions):
+            key = (r.pattern, r.path, r.line)
+            if key in seen_reads:
+                continue
+            seen_reads.add(key)
+            family = r.pattern.split(".", 1)[0]
+            if family not in families:
+                # reads into a family with no writers in scope: partial
+                # sweep or a foreign namespace — not this rule's call
+                continue
+            if surf.has_writer(r.pattern):
+                continue
+            code_finding(
+                r,
+                f"metric `{r.pattern}` is read here but no instrumentation "
+                "site can produce it — the reader is steering on a series "
+                "nobody writes (typo'd or renamed metric?)",
+            )
+
+        return findings

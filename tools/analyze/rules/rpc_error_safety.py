@@ -1,0 +1,197 @@
+"""rpc-error-safety: exceptions crossing an RPC boundary must survive it.
+
+Head/worker/replica RPC ops ship exceptions to the caller as pickled
+``("err", exc)`` payloads. Two ways that breaks:
+
+- the type is defined in a module the *client* process never imports (an
+  etl/serve-internal class) — unpickling raises ``ModuleNotFoundError``
+  inside the error path, replacing the real failure. Every exception raised
+  inside an RPC-served file must be stdlib or defined in
+  ``cluster/common.py`` (imported by every process at bootstrap).
+- the type's ``__init__`` takes required extra args it does not forward to
+  ``super().__init__``: ``BaseException.__reduce__`` replays ``self.args``,
+  so round-trip loses the attrs (the ``TenantQuotaError.tenant`` contract).
+
+RPC-served files are the known serving modules below; a fixture or new
+surface opts in with a ``# raydp-lint: rpc-surface`` marker comment. Types
+imported from outside the project are opaque (not flagged). Bare ``raise``
+re-raises are fine — they propagate whatever arrived.
+"""
+
+from __future__ import annotations
+
+import ast
+import builtins
+from typing import Dict, List, Optional, Set
+
+from tools.analyze.core import Finding, Project
+
+_COMMON = "cluster/common.py"
+
+_RPC_SURFACE_FILES = {
+    "raydp_tpu/cluster/head.py",
+    "raydp_tpu/cluster/worker.py",
+    "raydp_tpu/cluster/agent.py",
+    "raydp_tpu/store/block_service.py",
+    "raydp_tpu/etl/executor.py",
+    "raydp_tpu/serve/replica.py",
+}
+
+_MARKER = "raydp-lint: rpc-surface"
+
+
+def _is_builtin_exception(name: str) -> bool:
+    obj = getattr(builtins, name, None)
+    return isinstance(obj, type) and issubclass(obj, BaseException)
+
+
+def _exc_classes(tree: ast.AST) -> Dict[str, ast.ClassDef]:
+    """Class defs in this module that look like exception types: a base is a
+    builtin exception or an *Error/*Exception-named class."""
+    out: Dict[str, ast.ClassDef] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        for base in node.bases:
+            name = base.attr if isinstance(base, ast.Attribute) else (
+                base.id if isinstance(base, ast.Name) else None
+            )
+            if name and (
+                _is_builtin_exception(name)
+                or name.endswith(("Error", "Exception"))
+            ):
+                out[node.name] = node
+                break
+    return out
+
+
+def _raised_type_name(node: ast.Raise) -> Optional[str]:
+    exc = node.exc
+    if exc is None:
+        return None  # bare re-raise
+    if isinstance(exc, ast.Call):
+        exc = exc.func
+    if isinstance(exc, ast.Name):
+        return exc.id
+    if isinstance(exc, ast.Attribute):
+        return exc.attr  # P.ProgramCacheMiss -> ProgramCacheMiss
+    return None
+
+
+def _init_forwards_args(cls: ast.ClassDef) -> Optional[List[str]]:
+    """None if the class has no custom ``__init__`` (or defines
+    ``__reduce__``); otherwise the list of required extra params NOT
+    forwarded positionally to ``super().__init__``."""
+    init = None
+    for item in cls.body:
+        if isinstance(item, ast.FunctionDef):
+            if item.name == "__reduce__":
+                return None
+            if item.name == "__init__":
+                init = item
+    if init is None:
+        return None
+    params = [a.arg for a in init.args.args[1:]]  # drop self
+    n_defaults = len(init.args.defaults)
+    required = params[: len(params) - n_defaults] if n_defaults else params
+    if not required:
+        return None
+    forwarded: Set[str] = set()
+    for node in ast.walk(init):
+        if isinstance(node, ast.Call):
+            fn = node.func
+            is_super_init = (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "__init__"
+                and isinstance(fn.value, ast.Call)
+                and isinstance(fn.value.func, ast.Name)
+                and fn.value.func.id == "super"
+            )
+            if is_super_init:
+                for arg in node.args:
+                    if isinstance(arg, ast.Name):
+                        forwarded.add(arg.id)
+                    elif isinstance(arg, ast.Starred) and isinstance(
+                        arg.value, ast.Name
+                    ):
+                        forwarded.add(arg.value.id)
+                    elif isinstance(arg, ast.JoinedStr):
+                        for part in ast.walk(arg):
+                            if isinstance(part, ast.Name):
+                                forwarded.add(part.id)
+    missing = [p for p in required if p not in forwarded]
+    return missing or None
+
+
+class RpcErrorSafetyRule:
+    name = "rpc-error-safety"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+
+        # name -> defining display path, for every exception-ish class
+        defined_in: Dict[str, str] = {}
+        common_classes: Dict[str, ast.ClassDef] = {}
+        for src in project:
+            if src.tree is None:
+                continue
+            classes = _exc_classes(src.tree)
+            path = src.display_path.replace("\\", "/")
+            for cname, cnode in classes.items():
+                defined_in.setdefault(cname, src.display_path)
+                if path.endswith(_COMMON):
+                    common_classes[cname] = cnode
+
+        # ---- pickle round-trip contract on cluster/common.py types
+        for src in project:
+            path = src.display_path.replace("\\", "/")
+            if not path.endswith(_COMMON) or src.tree is None:
+                continue
+            for cname, cnode in _exc_classes(src.tree).items():
+                missing = _init_forwards_args(cnode)
+                if missing:
+                    findings.append(
+                        src.finding(
+                            self.name, cnode,
+                            f"exception `{cname}` takes required arg(s) "
+                            f"{', '.join(missing)} but does not forward them "
+                            "to super().__init__ — BaseException.__reduce__ "
+                            "replays self.args, so pickling across the RPC "
+                            "boundary loses them; forward the args or define "
+                            "__reduce__",
+                        )
+                    )
+
+        # ---- raises inside RPC-served files
+        for src in project:
+            if src.tree is None:
+                continue
+            path = src.display_path.replace("\\", "/")
+            is_surface = path in _RPC_SURFACE_FILES or _MARKER in src.text
+            if not is_surface:
+                continue
+            local_classes = set(_exc_classes(src.tree))
+            for node in ast.walk(src.tree):
+                if not isinstance(node, ast.Raise):
+                    continue
+                tname = _raised_type_name(node)
+                if tname is None or _is_builtin_exception(tname):
+                    continue
+                home = defined_in.get(tname)
+                if home is None:
+                    continue  # imported from outside the project: opaque
+                home_norm = home.replace("\\", "/")
+                if home_norm.endswith(_COMMON):
+                    continue
+                if tname in local_classes and path.endswith(_COMMON):
+                    continue
+                findings.append(
+                    src.finding(
+                        self.name, node,
+                        f"raises `{tname}` (defined in {home}) inside an "
+                        "RPC-served op — the client process may not import "
+                        "that module, so unpickling the error payload fails; "
+                        "define the type in cluster/common.py",
+                    )
+                )
+        return findings
