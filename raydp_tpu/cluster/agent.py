@@ -99,8 +99,8 @@ class NodeAgent:
     def handle_ping(self):
         return "pong"
 
-    # raydp-lint: disable=rpc-protocol (operator introspection surface —
-    # poked ad hoc over the agent socket, no in-tree call site)
+    # raydp-lint: disable=rpc-protocol,rpc-closure (operator introspection
+    # surface — poked ad hoc over the agent socket, no in-tree call site)
     def handle_stats(self):
         with self.lock:
             return dict(self.stats)

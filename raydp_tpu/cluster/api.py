@@ -472,7 +472,9 @@ class ActorFuture:
                 readable, _, _ = select.select([self._sock], [], [], wait)
                 if not readable:
                     raise TimeoutError(f"no reply within {wait}s")
-            self._sock.settimeout(self._timeout or 300.0)
+            self._sock.settimeout(
+                300.0 if self._timeout is None else self._timeout
+            )
             try:
                 status, value = recv_frame(self._sock)
             except BaseException:
@@ -634,7 +636,7 @@ class ActorHandle:
                 pooled = None
         if pooled is not None:
             try:
-                pooled.settimeout(timeout or 300.0)
+                pooled.settimeout(300.0 if timeout is None else timeout)
                 send_frame(pooled, frame)
             except OSError:
                 try:
@@ -647,7 +649,9 @@ class ActorHandle:
                     return _CompletedFuture()
                 return ActorFuture(pooled, timeout, pool_key=sock_path)
         try:
-            sock = connect(sock_path, timeout=timeout or 300.0)
+            sock = connect(
+                sock_path, timeout=300.0 if timeout is None else timeout
+            )
         except OSError as exc:
             raise _ConnectFailed(str(exc)) from exc
         try:
@@ -673,7 +677,8 @@ class ActorHandle:
             except _ConnectFailed:
                 self._cached_sock = None  # actor moved/restarted; fall through to head lookup
         sends_failed = 0
-        deadline = time.monotonic() + (timeout or 300.0)
+        # an explicit timeout=0 must mean "no budget", not the 300s default
+        deadline = time.monotonic() + (300.0 if timeout is None else timeout)
         while True:
             record = self._record()
             if record is None:

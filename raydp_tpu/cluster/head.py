@@ -14,6 +14,7 @@ never starve the control plane.
 
 from __future__ import annotations
 
+import difflib
 import itertools as _itertools
 import os
 import signal
@@ -434,8 +435,9 @@ class Head:
                 for pg_id, pg in self.pgs.items()
             }
 
-    # raydp-lint: disable=rpc-protocol (round-robin bundle cursor: public PG
-    # scheduling surface kept for Ray-parity callers; no in-tree call site)
+    # raydp-lint: disable=rpc-protocol,rpc-closure (round-robin bundle
+    # cursor: public PG scheduling surface for Ray-parity callers; no
+    # in-tree call site)
     def handle_pg_next_bundle(self, pg_id: str) -> int:
         with self.lock:
             pg = self.pgs[pg_id]
@@ -1971,6 +1973,23 @@ def _pid_alive(pid: int) -> bool:
         return True
 
 
+def _unknown_method_error(head: "Head", method: str) -> ClusterError:
+    """A self-diagnosing unknown-op error: under version skew (old client /
+    new head or vice versa) the raw ``unknown head method 'x'`` forced a
+    source dive — naming the nearest ``handle_*`` candidates turns a renamed
+    op into a one-glance fix. Counted so a fleet speaking a drifted protocol
+    shows up in telemetry, not just in one caller's traceback."""
+    obs_metrics.counter("head.unknown_method_calls").inc()
+    ops = sorted(
+        name[len("handle_"):]
+        for name in dir(head)
+        if name.startswith("handle_") and callable(getattr(head, name))
+    )
+    near = difflib.get_close_matches(method, ops, n=3, cutoff=0.5)
+    hint = f" (nearest handlers: {', '.join(near)})" if near else ""
+    return ClusterError(f"unknown head method {method!r}{hint}")
+
+
 class _Handler(socketserver.BaseRequestHandler):
     def handle(self):
         head: Head = self.server.head  # type: ignore[attr-defined]
@@ -1993,7 +2012,7 @@ class _Handler(socketserver.BaseRequestHandler):
             try:
                 fn = getattr(head, f"handle_{method}", None)
                 if fn is None:
-                    raise ClusterError(f"unknown head method {method!r}")
+                    raise _unknown_method_error(head, method)
                 if trace_ctx is not None and not method.startswith("obs_"):
                     # adopt the caller's trace: the head's handling of a
                     # traced control-plane call becomes a child span on the

@@ -106,10 +106,10 @@ def _serve(
                 # the method body so its spans link into the caller's trace
                 frame, trace_ctx = unwrap_traced(frame)
                 method, args, kwargs, no_reply = frame
-                if method == "__ping__":
+                if method == "__ping__":  # raydp-lint: disable=rpc-closure (transport liveness probe: sent by operators/tools over a raw socket, never via ActorHandle — __getattr__ refuses dunder dispatch)
                     send_frame(self.request, ("ok", "pong"))
                     continue
-                if method == "__shutdown__":
+                if method == "__shutdown__":  # raydp-lint: disable=rpc-closure (graceful-stop escape hatch, same raw-socket-only reachability as __ping__)
                     send_frame(self.request, ("ok", True))
                     stop_event.set()
                     return

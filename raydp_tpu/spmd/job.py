@@ -274,7 +274,7 @@ class SpmdJob:
             func_id = self._func_id
             self._func_id += 1
         blob = cloudpickle.dumps(fn)
-        wait = timeout or self.timeout
+        wait = self.timeout if timeout is None else timeout
         futures = [
             w.run_function.options(timeout=wait).remote(func_id, blob)
             for w in self._workers

@@ -1,0 +1,46 @@
+"""Seeded rpc-closure violations: one per closure direction on each plane,
+plus the timeout ``or``-default idiom. Every line marked BUG must be flagged;
+nothing else may be."""
+
+from raydp_tpu.cluster.common import rpc, send_frame
+
+
+class MiniHead:
+    def handle_echo(self, text):
+        return text
+
+    def handle_put(self, key, value, ttl=None):
+        return key
+
+    def handle_orphaned(self):  # BUG: dead wire surface, nobody sends it
+        return {"ok": 1}
+
+
+class Widget:
+    def widget_op(self, x):
+        return x * 2
+
+    def ack(self):
+        return True
+
+
+def boot(cluster):
+    return cluster.spawn(Widget)
+
+
+def client(addr, handle, timeout=None):
+    wait = timeout or 30.0  # BUG: an explicit timeout=0 becomes 30s
+    rpc(addr, ("echo", {"text": "hi"}), timeout=wait)
+    rpc(addr, ("ecoh", {"text": "hi"}))  # BUG: unknown frame op
+    rpc(addr, ("put", {"key": "k", "vlaue": 1}))  # BUG: kwarg typo
+    handle.widget_op.remote(1, 2)  # BUG: actor arity mismatch
+    handle.frobnicate.remote()  # BUG: unknown actor method
+
+
+def doorbell_server(sock, method):
+    if method == "__ding__":  # BUG: dead doorbell, no frame sends it
+        send_frame(sock, ("ok", "dong"))
+
+
+def doorbell_client(sock):
+    send_frame(sock, ("__dong__", (), {}, False))  # BUG: unknown doorbell op

@@ -1,0 +1,41 @@
+"""The fixed rpcclosure fixture: every send has a handler, every handler a
+sender, every call shape binds, and the timeout default branches on None."""
+
+from raydp_tpu.cluster.common import rpc, send_frame
+
+
+class MiniHead:
+    def handle_echo(self, text):
+        return text
+
+    def handle_put(self, key, value, ttl=None):
+        return key
+
+
+class Widget:
+    def widget_op(self, x):
+        return x * 2
+
+    def ack(self):
+        return True
+
+
+def boot(cluster):
+    return cluster.spawn(Widget)
+
+
+def client(addr, handle, timeout=None):
+    wait = 30.0 if timeout is None else timeout
+    rpc(addr, ("echo", {"text": "hi"}), timeout=wait)
+    rpc(addr, ("put", {"key": "k", "value": 1, "ttl": 5}))
+    handle.widget_op.remote(7)
+    handle.ack.remote()
+
+
+def doorbell_server(sock, method):
+    if method == "__ding__":
+        send_frame(sock, ("ok", "dong"))
+
+
+def doorbell_client(sock):
+    send_frame(sock, ("__ding__", (), {}, False))

@@ -1,0 +1,29 @@
+"""Seeded rpc-lock-flow violation: a frame handler reaches an outbound RPC
+THROUGH a helper while holding a named lock — the cross-process deadlock
+shape blocking-under-lock's lexical check cannot see."""
+
+import threading
+
+from raydp_tpu.cluster.common import rpc
+
+
+class MiniRegistry:
+    def __init__(self, peers):
+        self._lock = threading.Lock()
+        self._peers = peers
+        self._epoch = 0
+
+    def handle_join(self, addr):
+        with self._lock:
+            self._peers.append(addr)
+            self._broadcast()  # BUG: fans out RPCs while _lock is held
+        return len(self._peers)
+
+    def handle_leave(self, addr):
+        with self._lock:
+            self._peers.remove(addr)
+        self._broadcast()  # off-lock: fine
+
+    def _broadcast(self):
+        for peer in self._peers:
+            rpc(peer, ("epoch", {"value": self._epoch}))

@@ -1,0 +1,31 @@
+"""The fixed rpclockflow fixture: snapshot under the lock, send outside —
+the Head._unlink_objects idiom. Zero findings."""
+
+import threading
+
+from raydp_tpu.cluster.common import rpc
+
+
+class MiniRegistry:
+    def __init__(self, peers):
+        self._lock = threading.Lock()
+        self._peers = peers
+        self._epoch = 0
+
+    def handle_join(self, addr):
+        with self._lock:
+            self._peers.append(addr)
+            targets = list(self._peers)
+            count = len(targets)
+        self._broadcast(targets)
+        return count
+
+    def handle_leave(self, addr):
+        with self._lock:
+            self._peers.remove(addr)
+            targets = list(self._peers)
+        self._broadcast(targets)
+
+    def _broadcast(self, targets):
+        for peer in targets:
+            rpc(peer, ("epoch", {"value": self._epoch}))

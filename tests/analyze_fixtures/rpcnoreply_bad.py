@@ -1,0 +1,20 @@
+"""Seeded rpc-no-reply violation: a fire-and-forget send targeting a method
+whose return value is meaningful — the caller reads None forever."""
+
+
+class Tally:
+    def __init__(self):
+        self.total = 0
+
+    def bump(self, n):
+        self.total += n
+        return self.total  # a meaningful reply
+
+    def ping(self):
+        return True  # a droppable ack
+
+
+def main(cluster):
+    handle = cluster.spawn(Tally)
+    handle.bump.options(no_reply=True).remote(1)  # BUG: discards the count
+    return handle

@@ -1,0 +1,21 @@
+"""The fixed rpcnoreply fixture: no_reply only drops a constant ack; the
+meaningful reply travels on a replied call. Zero findings."""
+
+
+class Tally:
+    def __init__(self):
+        self.total = 0
+
+    def bump(self, n):
+        self.total += n
+        return self.total
+
+    def ping(self):
+        return True
+
+
+def main(cluster):
+    handle = cluster.spawn(Tally)
+    handle.ping.options(no_reply=True).remote()  # ack: fine to drop
+    fut = handle.bump.remote(1)  # the count rides a replied call
+    return fut.result()

@@ -1,0 +1,41 @@
+"""Seeded rpc-payload-safety violations: process-bound state in call-site
+payloads and in handler returns (every BUG line must be flagged)."""
+
+import socket
+import threading
+
+import jax.numpy as jnp
+
+from raydp_tpu.cluster.common import rpc
+
+
+class StatHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def handle_snapshot(self):
+        return self._lock  # BUG: a lock in a handler return
+
+    def handle_stream(self, n):
+        for i in range(n):
+            yield i  # BUG: handler is a generator
+
+    def handle_tail(self, path):
+        return open(path)  # BUG: an OS handle in a handler return
+
+    def push(self, addr):
+        chan = socket.socket()
+        rpc(
+            addr,
+            (
+                "ingest",
+                {
+                    "rows": (r for r in self._rows),  # BUG: generator payload
+                    "guard": self._lock,  # BUG: lock payload
+                    "mutex": threading.Lock(),  # BUG: threading primitive
+                    "chan": chan,  # BUG: socket, one provenance hop back
+                    "data": jnp.ones(4),  # BUG: raw jax value
+                },
+            ),
+        )

@@ -1,0 +1,41 @@
+"""The fixed rpcpayload fixture: everything crossing the wire is marshaled
+host-side first (lists, read() bytes, np.asarray) — zero findings."""
+
+import threading
+
+import jax.numpy as jnp
+import numpy as np
+
+from raydp_tpu.cluster.common import rpc
+
+
+class StatHolder:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows = []
+
+    def handle_snapshot(self):
+        with self._lock:
+            return list(self._rows)
+
+    def handle_stream(self, n):
+        return [i for i in range(n)]
+
+    def handle_tail(self, path):
+        with open(path) as f:
+            return f.read()
+
+    def push(self, addr):
+        with self._lock:
+            rows = list(self._rows)
+        rpc(
+            addr,
+            (
+                "ingest",
+                {
+                    "rows": rows,
+                    "data": np.asarray(jnp.ones(4)),
+                    "scale": float(np.mean(rows or [0.0])),
+                },
+            ),
+        )

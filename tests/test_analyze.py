@@ -495,6 +495,24 @@ def test_cli_exit_codes():
     assert good.returncode == 0, good.stdout
 
 
+def test_list_rules_names_all_sixteen():
+    """--list-rules prints one line per registered rule, falling back to the
+    module docstring for rules documented there rather than on the class."""
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    done = subprocess.run(
+        [sys.executable, "-m", "tools.analyze", "--list-rules"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert done.returncode == 0, done.stderr
+    lines = [l for l in done.stdout.splitlines() if l.strip()]
+    assert len(lines) == len(ALL_RULES) == 16
+    listed = {l.split(":", 1)[0] for l in lines}
+    assert {"rpc-closure", "rpc-payload-safety", "rpc-no-reply",
+            "rpc-lock-flow", "conf-registry"} <= listed
+    # every line carries a one-line description, none are bare
+    assert all(l.split(":", 1)[1].strip() for l in lines)
+
+
 def test_rule_comma_separated_cli():
     """--rule accepts a comma-separated list (and stays repeatable)."""
     env = dict(os.environ, PYTHONPATH=REPO_ROOT)
@@ -540,6 +558,293 @@ def test_fixture_dir_excluded_via_config():
     )
     assert narrowed.returncode == 0
     assert "0 finding(s)" in narrowed.stdout
+
+
+# ---------------------------------------------------------------------------
+# the rpc-* rule family (v4): wire-surface closure on seeded fixtures
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_closure_catches_seed():
+    """All three planes in one fixture: unknown/dead/arity on the frame
+    plane, unknown+arity on the actor plane, unknown+dead on the doorbell
+    plane, plus the timeout `or`-default idiom."""
+    found = run_rule("rpc-closure", "rpcclosure_bad.py")
+    messages = "\n".join(f.message for f in found)
+    assert len(found) == 8, messages
+    for marker in (
+        "unknown frame op 'ecoh'",
+        "frame op 'put' arity mismatch",
+        "dead wire surface: MiniHead.handle_orphaned",
+        "actor arity mismatch for 'widget_op'",
+        "unknown actor method 'frobnicate'",
+        "unknown doorbell op '__dong__'",
+        "dead doorbell surface: '__ding__'",
+        "`timeout or <default>` in client",
+    ):
+        assert marker in messages, marker
+    # every seeded violation sits on a BUG-marked line and vice versa
+    src = open(os.path.join(FIXTURES, "rpcclosure_bad.py")).read().splitlines()
+    assert sorted(f.line for f in found) == sorted(
+        i + 1 for i, line in enumerate(src) if "# BUG" in line
+    )
+
+
+def test_rpc_closure_clean_on_fixed():
+    assert run_rule("rpc-closure", "rpcclosure_good.py") == []
+
+
+def test_rpc_payload_safety_catches_seed():
+    found = run_rule("rpc-payload-safety", "rpcpayload_bad.py")
+    messages = "\n".join(f.message for f in found)
+    assert len(found) == 8, messages
+    for marker in (
+        "returns the lock",
+        "is a generator — its 'return value' cannot cross the wire",
+        "returns an OS handle (open(...))",
+        "ships a generator expression",
+        "ships the lock",  # via the project lock model
+        "ships a threading primitive (threading.Lock(...))",
+        "'chan', assigned an OS handle (socket.socket(...))",
+        "a raw jax value (jnp.ones(...))",
+    ):
+        assert marker in messages, marker
+
+
+def test_rpc_payload_safety_clean_on_fixed():
+    """Marshaled payloads (list(...), np.asarray(jnp...), float(...)) and
+    host-side handler returns pass — the approved-marshal early exit."""
+    assert run_rule("rpc-payload-safety", "rpcpayload_good.py") == []
+
+
+def test_rpc_no_reply_catches_seed():
+    found = run_rule("rpc-no-reply", "rpcnoreply_bad.py")
+    assert len(found) == 1
+    assert "no_reply=True send of 'bump'" in found[0].message
+    assert "Tally.bump(n)" in found[0].message
+
+
+def test_rpc_no_reply_clean_on_fixed():
+    """Dropping a constant ack (`return True`) is fine; the meaningful reply
+    rides a replied call."""
+    assert run_rule("rpc-no-reply", "rpcnoreply_good.py") == []
+
+
+def test_rpc_lock_flow_catches_seed():
+    """The acceptance-criteria fixture: a handler that reaches `rpc(...)`
+    through a helper while a named lock is held — invisible to
+    blocking-under-lock's lexical check."""
+    found = run_rule("rpc-lock-flow", "rpclockflow_bad.py")
+    assert len(found) == 1
+    msg = found[0].message
+    assert "handle_join" in msg
+    assert "self._broadcast() -> outbound RPC 'rpc(...)'" in msg
+    assert "MiniRegistry._lock" in msg
+    assert "snapshot under the lock, send outside" in msg
+
+
+def test_rpc_lock_flow_clean_on_fixed():
+    """The same shape with the send hoisted off-lock (the
+    Head._unlink_objects idiom) is clean — including the off-lock
+    `self._broadcast()` in handle_leave."""
+    assert run_rule("rpc-lock-flow", "rpclockflow_good.py") == []
+
+
+# ---------------------------------------------------------------------------
+# white-box: the shared RPC-surface extraction pass
+# ---------------------------------------------------------------------------
+
+
+def test_rpc_surface_extraction_on_fixture():
+    """One extraction feeds all four rules: frame handlers with signatures,
+    spawn()-derived actor surface, doorbell comparisons, literal 4-tuple
+    doorbell sends, and timeout-`or` sites."""
+    project = load_project([os.path.join(FIXTURES, "rpcclosure_bad.py")])
+    surf = project.rpc_surface()
+    assert set(surf.frame_handlers) == {"echo", "put", "orphaned"}
+    put = surf.frame_handlers["put"][0]
+    assert (put.required, put.optional) == (["key", "value"], ["ttl"])
+    assert put.signature() == "MiniHead.handle_put(key, value, ttl=…)"
+    assert surf.actor_classes == {"Widget"}
+    assert set(surf.actor_handlers) == {"widget_op", "ack"}
+    assert set(surf.doorbell_handlers) == {"__ding__"}
+    assert {c.op for c in surf.calls_on("doorbell")} == {"__dong__"}
+    assert [s.name for s in surf.timeout_or_sites] == ["timeout"]
+    # memoized: the same object comes back on the second ask
+    assert project.rpc_surface() is surf
+
+
+def test_rpc_surface_no_reply_and_spawn_extraction():
+    """`.options(no_reply=True).remote(...)` is one actor-plane site with the
+    flag set; the plain `.remote(...)` next to it is not."""
+    project = load_project([os.path.join(FIXTURES, "rpcnoreply_good.py")])
+    surf = project.rpc_surface()
+    assert surf.actor_classes == {"Tally"}
+    by_op = {c.op: c for c in surf.calls_on("actor")}
+    assert by_op["ping"].no_reply and by_op["ping"].via == "remote"
+    assert not by_op["bump"].no_reply
+    # `return True` is a droppable ack, `return self.total` is not
+    assert not surf.actor_handlers["ping"][0].returns_value
+    assert surf.actor_handlers["bump"][0].returns_value
+
+
+def test_rpc_surface_envelope_and_head_rpc(tmp_path):
+    """A literal ('__obs__', ctx, request) trace envelope unwraps to the
+    inner request, and head_rpc eats its own timeout kwarg."""
+    path = tmp_path / "wire.py"
+    path.write_text(
+        "def send(addr, ctx, spec):\n"
+        "    rpc(addr, ('__obs__', ctx, ('put', {'key': 1})))\n"
+        "    head_rpc('create_actor', spec=spec, timeout=5)\n"
+    )
+    surf = load_project([str(path)]).rpc_surface()
+    shapes = {(c.op, frozenset(c.kwargs or ())) for c in surf.calls_on("frame")}
+    assert ("put", frozenset({"key"})) in shapes
+    assert ("create_actor", frozenset({"spec"})) in shapes
+
+
+def _full_sweep_project():
+    from tools.analyze.__main__ import config_excludes
+
+    return load_project(
+        [
+            os.path.join(REPO_ROOT, "raydp_tpu"),
+            os.path.join(REPO_ROOT, "tools"),
+            os.path.join(REPO_ROOT, "bench.py"),
+            os.path.join(REPO_ROOT, "examples"),
+            os.path.join(REPO_ROOT, "tests", "conftest.py"),
+        ],
+        root=REPO_ROOT,
+        exclude=config_excludes(REPO_ROOT),
+    )
+
+
+def test_rpc_surface_real_tree_anchors():
+    """The extraction finds the protocol the docs describe: the head's
+    create_actor frame op, every spawn()-ed actor class, and the worker
+    doorbell — and the tree has zero timeout-`or` sites left (satellite 1)."""
+    surf = _full_sweep_project().rpc_surface()
+    h = surf.frame_handlers["create_actor"][0]
+    assert (h.cls, h.required) == ("Head", ["spec"])
+    assert surf.actor_classes == {
+        "BlockService", "EtlExecutor", "ModelReplica", "ObjectHolder",
+        "SpmdWorker",
+    }
+    assert set(surf.doorbell_handlers) == {"__ping__", "__shutdown__"}
+    assert surf.timeout_or_sites == []
+    # ActorHandle.__getattr__ refuses leading underscores: no _private
+    # method may appear on the wire-reachable actor surface
+    assert not [op for op in surf.actor_handlers if op.startswith("_")]
+
+
+# ---------------------------------------------------------------------------
+# the contract snapshot gate
+# ---------------------------------------------------------------------------
+
+
+def _committed_contract():
+    from tools.analyze.rpc import CONTRACT_FILE
+
+    with open(os.path.join(REPO_ROOT, CONTRACT_FILE), encoding="utf-8") as f:
+        return json.load(f)
+
+
+def test_rpc_contract_matches_committed():
+    """Exactly what CI's --check-contract gates on: the live wire surface
+    rebuilds byte-for-byte into the committed snapshot."""
+    from tools.analyze.rpc import build_contract, check_contract
+
+    surf = _full_sweep_project().rpc_surface()
+    committed = _committed_contract()
+    assert check_contract(surf, committed) == []
+    assert build_contract(surf) == committed
+
+
+def test_rpc_contract_mutation_drill():
+    """The acceptance-criteria drill: rename a real handle_* in a mutated
+    copy of head.py and the gate must fail from BOTH directions — rpc-closure
+    flags the now-orphaned api.py caller AND the dead renamed handler, and
+    --check-contract reports the surface change."""
+    from tools.analyze.core import Project, SourceFile
+    from tools.analyze.rpc import check_contract
+
+    project = _full_sweep_project()
+    target = os.path.join("raydp_tpu", "cluster", "head.py")
+    src = project.file(target)
+    assert src is not None and "def handle_create_actor(" in src.text
+    mutated = SourceFile(
+        src.path, src.display_path,
+        src.text.replace("def handle_create_actor(",
+                         "def handle_create_actorr("),
+    )
+    files = [mutated if f.display_path == target else f for f in project.files]
+    mutated_project = Project(files, root=REPO_ROOT)
+    findings = run_rules(
+        mutated_project, [rules_by_name()["rpc-closure"]()]
+    )
+    active = [f for f in findings if not f.suppressed]
+    rendered = "\n".join(f.render() for f in active)
+    assert any(
+        "unknown frame op 'create_actor'" in f.message
+        and f.path.endswith("api.py")
+        for f in active
+    ), rendered
+    assert any(
+        "dead wire surface: Head.handle_create_actorr" in f.message
+        for f in active
+    ), rendered
+    problems = check_contract(
+        mutated_project.rpc_surface(), _committed_contract()
+    )
+    text = "\n".join(problems)
+    assert "frame op 'create_actorr' exists in the tree" in text
+    assert "frame op 'create_actor' is in the committed contract" in text
+    assert all("--write-contract" in p for p in problems)
+
+
+def test_rpc_contract_drift_on_signature_change():
+    """Same op, new kwarg: the op survives both sets but its handler entry
+    differs, so the contract reports a drift (not an add/remove)."""
+    from tools.analyze.rpc import build_contract, check_contract
+
+    surf = _full_sweep_project().rpc_surface()
+    committed = _committed_contract()
+    live = build_contract(surf)
+    assert live == committed  # precondition
+    committed["frame"]["create_actor"]["handlers"][0]["required"] = [
+        "spec", "shiny_new_arg",
+    ]
+    problems = check_contract(surf, committed)
+    assert len(problems) == 1
+    assert "frame op 'create_actor' drifted" in problems[0]
+
+
+def test_spliced_doc_replaces_between_markers():
+    from tools.analyze.__main__ import spliced_doc
+    from tools.analyze.rpc import RPC_TABLE_BEGIN, RPC_TABLE_END
+
+    doc = f"# title\n\n{RPC_TABLE_BEGIN}\nold rows\n{RPC_TABLE_END}\ntail\n"
+    out = spliced_doc(doc, "| new |")
+    assert "| new |" in out and "old rows" not in out
+    assert out.startswith("# title") and out.rstrip().endswith("tail")
+    with pytest.raises(ValueError):
+        spliced_doc("a doc without markers\n", "| new |")
+
+
+def test_rpc_contract_cli_gates_pass():
+    """The two CI steps verbatim: --check-contract and --check-rpc-table both
+    exit 0 against the committed contract and docs table."""
+    env = dict(os.environ, PYTHONPATH=REPO_ROOT)
+    done = subprocess.run(
+        [sys.executable, "-m", "tools.analyze",
+         "raydp_tpu/", "tools/", "bench.py", "examples/",
+         os.path.join("tests", "conftest.py"),
+         "--check-contract", "--check-rpc-table"],
+        cwd=REPO_ROOT, env=env, capture_output=True, text=True,
+    )
+    assert done.returncode == 0, done.stdout + done.stderr
+    assert "matches the committed contract" in done.stdout
+    assert "RPC surface table is current" in done.stdout
 
 
 def test_repo_is_lint_clean():

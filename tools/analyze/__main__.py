@@ -15,6 +15,15 @@ tests/analyze_fixtures/ live there, not as a hardcoded path check).
 them to ``tools/analyze/suppression_budget.json``; ``--check-budget`` fails
 when any rule suppresses more than its budgeted count — so a new suppression
 only lands together with an explicit budget-file change in the same diff.
+
+The RPC contract gate (tools/analyze/rpc.py) rides the same CLI:
+``--write-contract`` serializes the extracted wire surface to
+``tools/analyze/rpc_contract.json``; ``--check-contract`` fails when the live
+surface drifted from the committed snapshot — so a protocol change only lands
+together with an explicit, reviewable contract edit. ``--rpc-table`` prints
+the human-readable surface table, ``--write-rpc-table`` splices it between
+the rpc-surface markers in docs/cluster.md, and ``--check-rpc-table`` fails
+when the committed table is stale.
 """
 
 from __future__ import annotations
@@ -106,6 +115,91 @@ def config_excludes(root: str) -> list:
     ]
 
 
+def spliced_doc(text: str, table: str) -> str:
+    """The doc text with the generated table replacing whatever sits between
+    the rpc-surface markers; raises ValueError when the markers are missing
+    or inverted (the doc must carry them for the gate to have a home)."""
+    from tools.analyze.rpc import RPC_TABLE_BEGIN, RPC_TABLE_END
+
+    begin = text.find(RPC_TABLE_BEGIN)
+    end = text.find(RPC_TABLE_END)
+    if begin < 0 or end < 0 or end < begin:
+        raise ValueError(
+            f"rpc-surface markers missing or inverted "
+            f"({RPC_TABLE_BEGIN!r} … {RPC_TABLE_END!r})"
+        )
+    return (
+        text[: begin + len(RPC_TABLE_BEGIN)]
+        + "\n\n" + table + "\n\n"
+        + text[end:]
+    )
+
+
+def contract_main(args, project, root: str) -> int:
+    """--write-contract / --check-contract / --rpc-table /
+    --write-rpc-table / --check-rpc-table handling."""
+    from tools.analyze import rpc as rpcmod
+
+    surface = project.rpc_surface()
+    contract_path = os.path.join(root, rpcmod.CONTRACT_FILE)
+    docs_path = os.path.join(root, "docs", "cluster.md")
+    if args.write_contract:
+        with open(contract_path, "w", encoding="utf-8") as f:
+            f.write(rpcmod.render_contract(rpcmod.build_contract(surface)))
+        sys.stdout.write(f"wrote {os.path.relpath(contract_path)}\n")
+    if args.check_contract:
+        try:
+            with open(contract_path, encoding="utf-8") as f:
+                committed = json.load(f)
+        except FileNotFoundError:
+            sys.stderr.write(
+                f"rpc contract missing: {contract_path} "
+                "(create it with --write-contract)\n"
+            )
+            return 1
+        except (OSError, ValueError) as exc:
+            sys.stderr.write(f"unreadable rpc contract {contract_path}: {exc}\n")
+            return 1
+        problems = rpcmod.check_contract(surface, committed)
+        for line in problems:
+            sys.stderr.write(line + "\n")
+        if problems:
+            return 1
+        sys.stdout.write(
+            "raydp-lint: rpc wire surface matches the committed contract\n"
+        )
+    table = rpcmod.render_rpc_table(surface)
+    if args.rpc_table:
+        sys.stdout.write(table + "\n")
+    if args.write_rpc_table or args.check_rpc_table:
+        try:
+            with open(docs_path, encoding="utf-8") as f:
+                doc = f.read()
+        except OSError as exc:
+            sys.stderr.write(f"cannot read {docs_path}: {exc}\n")
+            return 1
+        try:
+            updated = spliced_doc(doc, table)
+        except ValueError as exc:
+            sys.stderr.write(f"{docs_path}: {exc}\n")
+            return 1
+        if args.write_rpc_table:
+            with open(docs_path, "w", encoding="utf-8") as f:
+                f.write(updated)
+            sys.stdout.write(f"wrote {os.path.relpath(docs_path)}\n")
+        if args.check_rpc_table:
+            if updated != doc:
+                sys.stderr.write(
+                    "docs/cluster.md RPC surface table is stale — regenerate "
+                    "with --write-rpc-table and commit the diff\n"
+                )
+                return 1
+            sys.stdout.write(
+                "raydp-lint: docs/cluster.md RPC surface table is current\n"
+            )
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.analyze",
@@ -142,13 +236,41 @@ def main(argv=None) -> int:
         help="fail if any rule's suppression count exceeds the committed "
         f"budget in {BUDGET_FILE}",
     )
+    parser.add_argument(
+        "--write-contract", action="store_true",
+        help="serialize the extracted RPC wire surface to "
+        f"{os.path.join('tools', 'analyze', 'rpc_contract.json')}",
+    )
+    parser.add_argument(
+        "--check-contract", action="store_true",
+        help="fail if the live RPC wire surface drifted from the committed "
+        "contract snapshot",
+    )
+    parser.add_argument(
+        "--rpc-table", action="store_true",
+        help="print the RPC surface table (op → caller files → handler)",
+    )
+    parser.add_argument(
+        "--write-rpc-table", action="store_true",
+        help="splice the generated RPC surface table into docs/cluster.md",
+    )
+    parser.add_argument(
+        "--check-rpc-table", action="store_true",
+        help="fail if docs/cluster.md's RPC surface table is stale",
+    )
     args = parser.parse_args(argv)
 
     registry = rules_by_name()
     if args.list_rules:
         for name in sorted(registry):
-            doc = (registry[name].__doc__ or "").strip().splitlines()[0]
-            sys.stdout.write(f"{name}: {doc}\n")
+            cls = registry[name]
+            # some rules document themselves on the module, not the class
+            doc = (cls.__doc__ or "").strip()
+            if not doc:
+                mod = sys.modules.get(cls.__module__)
+                doc = (getattr(mod, "__doc__", "") or "").strip()
+            first = doc.splitlines()[0] if doc else ""
+            sys.stdout.write(f"{name}: {first}\n")
         return 0
     if args.rule:
         wanted = [
@@ -171,6 +293,15 @@ def main(argv=None) -> int:
     root = find_root(args.paths)
     exclude = config_excludes(root) + list(args.exclude)
     project = load_project(args.paths, root=root, exclude=exclude)
+
+    if (
+        args.write_contract or args.check_contract or args.rpc_table
+        or args.write_rpc_table or args.check_rpc_table
+    ):
+        # surface-only modes: no findings run (CI calls these as separate,
+        # fast steps after the main sweep)
+        return contract_main(args, project, root)
+
     findings = run_rules(project, rules)
 
     if args.stats or args.write_budget or args.check_budget:

@@ -160,6 +160,7 @@ class Project:
         self.root = root or os.getcwd()
         self._by_path = {f.display_path: f for f in self.files}
         self._surfaces = None
+        self._rpc_surface = None
 
     def file(self, display_path: str) -> Optional[SourceFile]:
         return self._by_path.get(display_path)
@@ -173,6 +174,16 @@ class Project:
 
             self._surfaces = _surf.extract(self, self.root)
         return self._surfaces
+
+    def rpc_surface(self):
+        """Memoized RPC wire surface (see :mod:`tools.analyze.rpc`): every
+        handler and call site on the frame/actor/doorbell planes. Shared by
+        the four rpc-* rules and the contract gate — one walk, not four."""
+        if self._rpc_surface is None:
+            from tools.analyze import rpc as _rpc
+
+            self._rpc_surface = _rpc.extract(self)
+        return self._rpc_surface
 
     def __iter__(self):
         return iter(self.files)

@@ -1,0 +1,685 @@
+"""RPC wire-surface extraction: every handler, every call site, one model.
+
+The cluster speaks three stringly-typed planes, and nothing in the language
+ties their two ends together — a renamed handler or a drifted kwarg fails at
+runtime on a live cluster. This module extracts both ends statically so the
+rpc-* rules (and the committed contract snapshot) can close the loop:
+
+- **frame plane** — ``rpc(addr, ("op", {kwargs}))`` / ``rpc_pooled(...)`` /
+  ``head_rpc("op", kw=...)`` request tuples, dispatched by the head/agent
+  servers via ``getattr(obj, f"handle_{op}")(**kwargs)``. Servers are classes
+  defining ≥2 ``handle_*`` methods (``handle_request`` is socketserver API,
+  not an op). A literal ``("__obs__", ctx, request)`` trace envelope is
+  unwrapped to the inner request, mirroring ``unwrap_traced``.
+- **actor plane** — ``handle.<method>.remote(...)`` (optionally through
+  ``.options(no_reply=..., timeout=...)``) ships a ``(method, args, kwargs,
+  no_reply)`` frame applied as ``getattr(instance, method)(*args, **kwargs)``.
+  The wire-reachable server surface is the PUBLIC method set of classes that
+  are actually ``spawn()``-ed somewhere in the project
+  (``ActorHandle.__getattr__`` refuses leading underscores, so ``_private``
+  methods are not protocol). Direct ``_call("m", ...)`` / ``_try_send(addr,
+  "m", ...)`` invocations with a literal method string are the same plane.
+- **doorbell plane** — dunder transport ops (``__ping__``, ``__shutdown__``)
+  the actor server answers itself, before user dispatch: a handler is an
+  ``method == "__op__"`` comparison in a server loop, a call site is a
+  literal 4-tuple frame whose op is dunder-named.
+
+The extraction also records every ``<timeout-ish> or <default>`` expression
+(the idiom silently maps an explicit ``timeout=0`` to the default — use
+``default if timeout is None else timeout``), which rpc-closure reports as a
+lint note.
+
+Memoized per :class:`Project` via ``Project.rpc_surface()`` (sibling to
+``surfaces()`` and ``get_lock_model``): four rules and the contract gate
+share one walk.
+
+The committed contract (``tools/analyze/rpc_contract.json``) serializes op →
+handler signatures + caller files, WITHOUT line numbers — it changes only
+when the wire surface itself changes, and ``--check-contract`` fails CI when
+that happens without a contract edit in the same diff. ``--rpc-table`` emits
+the human-readable surface table for docs/cluster.md (this one carries
+``file:line`` anchors; regenerate with ``--write-rpc-table``).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import json
+import os
+from typing import Dict, Iterator, List, Optional, Set, Tuple
+
+from tools.analyze.core import (
+    Project,
+    SourceFile,
+    call_name,
+    const_str,
+    dotted_name,
+)
+
+OBS_FRAME_MARK = "__obs__"
+CONTRACT_FILE = os.path.join("tools", "analyze", "rpc_contract.json")
+
+#: frame-plane send helpers; ``head_rpc`` eats its own ``timeout`` kwarg
+FRAME_SEND_NAMES = ("rpc", "rpc_pooled")
+HEAD_RPC_NAME = "head_rpc"
+
+
+def own_nodes(fn: ast.AST) -> Iterator[ast.AST]:
+    """Nodes of ``fn``'s body excluding nested def/lambda bodies: closures
+    run later (often on another thread via ``threading.Thread``), so their
+    contents are not part of the function's own synchronous execution."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        yield node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)):
+            continue
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _returns_value(fn: ast.AST) -> bool:
+    """Does the function return anything a caller could USE? Bare constants
+    (``return True`` / ``"pong"``) are acks a ``no_reply`` send may drop;
+    any non-constant return expression is a meaningful reply."""
+    for node in own_nodes(fn):
+        if isinstance(node, ast.Return) and node.value is not None:
+            if not isinstance(node.value, ast.Constant):
+                return True
+    return False
+
+
+def _has_yield(fn: ast.AST) -> bool:
+    return any(
+        isinstance(n, (ast.Yield, ast.YieldFrom)) for n in own_nodes(fn)
+    )
+
+
+def _signature(
+    fn: ast.FunctionDef, drop_self: bool = True
+) -> Tuple[List[str], List[str], bool, bool]:
+    """(required, optional, has_var_args, has_var_kw) with ``self`` dropped."""
+    args = fn.args
+    names = [a.arg for a in (args.args[1:] if drop_self else args.args)]
+    n_def = len(args.defaults)
+    required = names[: len(names) - n_def] if n_def else list(names)
+    optional = names[len(names) - n_def:] if n_def else []
+    for a, d in zip(args.kwonlyargs, args.kw_defaults):
+        (optional if d is not None else required).append(a.arg)
+    return required, optional, args.vararg is not None, args.kwarg is not None
+
+
+@dataclasses.dataclass
+class Handler:
+    """One server-side endpoint (any plane)."""
+
+    plane: str  # "frame" | "actor" | "doorbell"
+    op: str
+    cls: str
+    src: SourceFile
+    node: ast.AST
+    required: List[str] = dataclasses.field(default_factory=list)
+    optional: List[str] = dataclasses.field(default_factory=list)
+    has_var_args: bool = False
+    has_var_kw: bool = False
+    returns_value: bool = False
+    has_yield: bool = False
+
+    def binds_kwargs(self, kwargs: Set[str]) -> bool:
+        """Frame plane: the server applies ``fn(**kwargs)``."""
+        accepted = set(self.required) | set(self.optional)
+        if not self.has_var_kw and not kwargs <= accepted:
+            return False
+        return set(self.required) <= kwargs
+
+    def binds_call(self, n_pos: int, kwnames: Set[str]) -> bool:
+        """Actor plane: the server applies ``fn(*args, **kwargs)``."""
+        params = list(self.required) + list(self.optional)
+        if not self.has_var_args and n_pos > len(params):
+            return False
+        positional = set(params[:n_pos])
+        if not self.has_var_kw and not kwnames <= set(params) - positional:
+            return False
+        return set(self.required) <= positional | kwnames
+
+    def signature(self) -> str:
+        parts = list(self.required) + [f"{o}=…" for o in self.optional]
+        if self.has_var_args:
+            parts.append("*a")
+        if self.has_var_kw:
+            parts.append("**kw")
+        name = f"handle_{self.op}" if self.plane == "frame" else self.op
+        owner = f"{self.cls}." if self.cls else ""
+        return f"{owner}{name}({', '.join(parts)})"
+
+    def contract_entry(self) -> dict:
+        """Line-number-free serialization: stable under unrelated edits."""
+        return {
+            "cls": self.cls,
+            "path": self.src.display_path,
+            "required": list(self.required),
+            "optional": list(self.optional),
+            "var_args": self.has_var_args,
+            "var_kw": self.has_var_kw,
+            "returns_value": self.returns_value,
+        }
+
+
+@dataclasses.dataclass
+class CallSite:
+    """One client-side invocation (any plane)."""
+
+    plane: str
+    op: str
+    src: SourceFile
+    node: ast.AST
+    via: str  # rpc | rpc_pooled | head_rpc | remote | _call | _try_send | frame
+    n_pos: int = 0  # actor plane; -1 = *spread (unknowable)
+    kwargs: Optional[Set[str]] = None  # None = not statically known
+    no_reply: bool = False
+    payloads: List[ast.AST] = dataclasses.field(default_factory=list)
+
+
+@dataclasses.dataclass
+class TimeoutOrSite:
+    """A ``<timeout-ish> or <default>`` expression."""
+
+    src: SourceFile
+    node: ast.AST
+    func_name: str
+    name: str  # the timeout-ish left operand, e.g. "timeout"/"self._timeout"
+
+
+@dataclasses.dataclass
+class RpcSurface:
+    frame_handlers: Dict[str, List[Handler]]
+    actor_classes: Set[str]  # class names seen as spawn()'s first argument
+    actor_handlers: Dict[str, List[Handler]]  # public methods of spawned classes
+    class_methods: Dict[str, List[Handler]]  # every project class (fallback)
+    doorbell_handlers: Dict[str, List[Handler]]
+    calls: List[CallSite]
+    timeout_or_sites: List[TimeoutOrSite]
+
+    def calls_on(self, plane: str) -> List[CallSite]:
+        return [c for c in self.calls if c.plane == plane]
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+
+def _collect_frame_handlers(project: Project) -> Dict[str, List[Handler]]:
+    handlers: Dict[str, List[Handler]] = {}
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            methods = [
+                m
+                for m in node.body
+                if isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and m.name.startswith("handle_")
+                and m.name != "handle_request"  # socketserver API, not an op
+            ]
+            if len(methods) < 2:
+                continue
+            for m in methods:
+                required, optional, var_args, var_kw = _signature(m)
+                op = m.name[len("handle_"):]
+                handlers.setdefault(op, []).append(
+                    Handler(
+                        plane="frame",
+                        op=op,
+                        cls=node.name,
+                        src=src,
+                        node=m,
+                        required=required,
+                        optional=optional,
+                        has_var_args=var_args,
+                        has_var_kw=var_kw,
+                        returns_value=_returns_value(m),
+                        has_yield=_has_yield(m),
+                    )
+                )
+    return handlers
+
+
+def _collect_spawned_classes(project: Project) -> Set[str]:
+    """Class names passed as the first positional argument to ``spawn(...)``
+    / ``cluster.spawn(...)`` — the only classes the actor wire can reach."""
+    spawned: Set[str] = set()
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            name = call_name(node)
+            if name is None or name.rsplit(".", 1)[-1] != "spawn":
+                continue
+            target = dotted_name(node.args[0])
+            if target is not None:
+                spawned.add(target.rsplit(".", 1)[-1])
+    return spawned
+
+
+def _method_handler(plane: str, cls: ast.ClassDef, m, src: SourceFile) -> Handler:
+    required, optional, var_args, var_kw = _signature(m)
+    return Handler(
+        plane=plane,
+        op=m.name,
+        cls=cls.name,
+        src=src,
+        node=m,
+        required=required,
+        optional=optional,
+        has_var_args=var_args,
+        has_var_kw=var_kw,
+        returns_value=_returns_value(m),
+        has_yield=_has_yield(m),
+    )
+
+
+def _collect_class_methods(
+    project: Project, spawned: Set[str]
+) -> Tuple[Dict[str, List[Handler]], Dict[str, List[Handler]]]:
+    """(actor_handlers, class_methods): the former is the wire-reachable
+    surface (public methods of spawned classes), the latter every method on
+    every project class — the closure fallback, so a dispatch on a handle
+    whose spawn site is out of scan scope is not a false 'unknown'."""
+    actor: Dict[str, List[Handler]] = {}
+    every: Dict[str, List[Handler]] = {}
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for m in node.body:
+                if not isinstance(m, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                    continue
+                h = _method_handler("actor", node, m, src)
+                every.setdefault(m.name, []).append(h)
+                if node.name in spawned and not m.name.startswith("_"):
+                    actor.setdefault(m.name, []).append(h)
+    return actor, every
+
+
+def _collect_doorbell_handlers(project: Project) -> Dict[str, List[Handler]]:
+    """``method == "__op__"`` comparisons in a server loop: the transport
+    answers these before user dispatch (worker.py's ping/shutdown doorbell)."""
+    handlers: Dict[str, List[Handler]] = {}
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            if not isinstance(node, ast.Compare) or len(node.ops) != 1:
+                continue
+            if not isinstance(node.ops[0], ast.Eq):
+                continue
+            left = dotted_name(node.left)
+            if left is None or left.rsplit(".", 1)[-1] != "method":
+                continue
+            op = const_str(node.comparators[0])
+            if op is None or not (op.startswith("__") and op.endswith("__")):
+                continue
+            handlers.setdefault(op, []).append(
+                Handler(plane="doorbell", op=op, cls="", src=src, node=node)
+            )
+    return handlers
+
+
+# ---------------------------------------------------------------------------
+# client side
+# ---------------------------------------------------------------------------
+
+
+def _frame_request(node: ast.AST) -> Optional[Tuple[str, Optional[Set[str]], List[ast.AST]]]:
+    """(op, kwargs-or-None, payload exprs) from a literal request tuple,
+    unwrapping a literal trace envelope; None when the shape is not the
+    named-op plane (actor 4-tuples and friends are out of scope here)."""
+    if not isinstance(node, ast.Tuple):
+        return None
+    elts = node.elts
+    if len(elts) == 3 and const_str(elts[0]) == OBS_FRAME_MARK:
+        return _frame_request(elts[2])
+    if len(elts) != 2:
+        return None
+    op = const_str(elts[0])
+    if op is None:
+        return None
+    kw_node = elts[1]
+    if isinstance(kw_node, ast.Dict):
+        keys: Set[str] = set()
+        payloads: List[ast.AST] = []
+        for k, v in zip(kw_node.keys, kw_node.values):
+            if k is None:  # **spread — arity unknowable, values still checkable
+                return op, None, list(kw_node.values)
+            ks = const_str(k)
+            if ks is None:
+                return op, None, list(kw_node.values)
+            keys.add(ks)
+            payloads.append(v)
+        return op, keys, payloads
+    return op, None, []
+
+
+def _keyword_flag(node: ast.Call, name: str) -> bool:
+    for kw in node.keywords:
+        if kw.arg == name and isinstance(kw.value, ast.Constant):
+            return bool(kw.value.value)
+    return False
+
+
+def _collect_calls(project: Project) -> List[CallSite]:
+    calls: List[CallSite] = []
+    for src in project:
+        if src.tree is None:
+            continue
+        for node in ast.walk(src.tree):
+            # doorbell: a literal 4-tuple frame with a dunder op
+            if isinstance(node, ast.Tuple) and len(node.elts) == 4:
+                op = const_str(node.elts[0])
+                if op and op.startswith("__") and op.endswith("__"):
+                    calls.append(
+                        CallSite("doorbell", op, src, node, via="frame")
+                    )
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            last = name.rsplit(".", 1)[-1] if name else None
+            if last in FRAME_SEND_NAMES and len(node.args) >= 2:
+                req = _frame_request(node.args[1])
+                if req is not None:
+                    op, kwargs, payloads = req
+                    calls.append(
+                        CallSite(
+                            "frame", op, src, node, via=last,
+                            kwargs=kwargs, payloads=payloads,
+                        )
+                    )
+            elif last == HEAD_RPC_NAME and node.args:
+                op = const_str(node.args[0])
+                if op is None:
+                    continue
+                kwargs: Optional[Set[str]] = set()
+                payloads = []
+                for kw in node.keywords:
+                    if kw.arg is None:  # **spread
+                        kwargs = None
+                        payloads.append(kw.value)
+                        continue
+                    if kw.arg == "timeout":  # consumed by the helper itself
+                        continue
+                    if kwargs is not None:
+                        kwargs.add(kw.arg)
+                    payloads.append(kw.value)
+                calls.append(
+                    CallSite(
+                        "frame", op, src, node, via=last,
+                        kwargs=kwargs, payloads=payloads,
+                    )
+                )
+            elif last in ("_call", "_try_send") and node.args:
+                # ActorHandle._call("m", args, kwargs, ...) /
+                # _try_send(sock_path, "m", ...): the method name is the
+                # first (resp. second) positional argument
+                op_node = node.args[0] if last == "_call" else (
+                    node.args[1] if len(node.args) > 1 else None
+                )
+                op = const_str(op_node) if op_node is not None else None
+                if op is not None:
+                    calls.append(
+                        CallSite(
+                            "actor", op, src, node, via=last,
+                            n_pos=-1, kwargs=None,
+                            no_reply=_keyword_flag(node, "no_reply"),
+                        )
+                    )
+            elif (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "remote"
+            ):
+                inner = node.func.value
+                no_reply = False
+                if (
+                    isinstance(inner, ast.Call)
+                    and isinstance(inner.func, ast.Attribute)
+                    and inner.func.attr == "options"
+                ):
+                    no_reply = _keyword_flag(inner, "no_reply")
+                    inner = inner.func.value
+                if not isinstance(inner, ast.Attribute):
+                    continue  # bare .remote() on a name: not this plane
+                kwnames: Optional[Set[str]] = set()
+                payloads = list(node.args)
+                for kw in node.keywords:
+                    payloads.append(kw.value)
+                    if kw.arg is None:
+                        kwnames = None
+                    elif kwnames is not None:
+                        kwnames.add(kw.arg)
+                n_pos = len(node.args)
+                if any(isinstance(a, ast.Starred) for a in node.args):
+                    n_pos = -1
+                calls.append(
+                    CallSite(
+                        "actor", inner.attr, src, node, via="remote",
+                        n_pos=n_pos, kwargs=kwnames, no_reply=no_reply,
+                        payloads=payloads,
+                    )
+                )
+    return calls
+
+
+# ---------------------------------------------------------------------------
+# timeout `or`-default idiom
+# ---------------------------------------------------------------------------
+
+
+def _timeoutish(name: Optional[str]) -> bool:
+    if name is None:
+        return False
+    return "timeout" in name.rsplit(".", 1)[-1]
+
+
+def _collect_timeout_or(project: Project) -> List[TimeoutOrSite]:
+    sites: List[TimeoutOrSite] = []
+    for src in project:
+        if src.tree is None:
+            continue
+        func_stack: List[str] = []
+
+        def visit(node: ast.AST) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                func_stack.append(node.name)
+                for child in ast.iter_child_nodes(node):
+                    visit(child)
+                func_stack.pop()
+                return
+            if isinstance(node, ast.BoolOp) and isinstance(node.op, ast.Or):
+                left = dotted_name(node.values[0])
+                if _timeoutish(left):
+                    sites.append(
+                        TimeoutOrSite(
+                            src, node,
+                            func_stack[-1] if func_stack else "<module>",
+                            left,
+                        )
+                    )
+            for child in ast.iter_child_nodes(node):
+                visit(child)
+
+        visit(src.tree)
+    return sites
+
+
+# ---------------------------------------------------------------------------
+# assembly + memoization
+# ---------------------------------------------------------------------------
+
+
+def extract(project: Project) -> RpcSurface:
+    frame_handlers = _collect_frame_handlers(project)
+    spawned = _collect_spawned_classes(project)
+    actor_handlers, class_methods = _collect_class_methods(project, spawned)
+    return RpcSurface(
+        frame_handlers=frame_handlers,
+        actor_classes=spawned,
+        actor_handlers=actor_handlers,
+        class_methods=class_methods,
+        doorbell_handlers=_collect_doorbell_handlers(project),
+        calls=_collect_calls(project),
+        timeout_or_sites=_collect_timeout_or(project),
+    )
+
+
+def get_rpc_surface(project: Project) -> RpcSurface:
+    """Memoized per project (four rules + the contract gate share it)."""
+    surface = getattr(project, "_rpc_surface", None)
+    if surface is None:
+        surface = extract(project)
+        project._rpc_surface = surface  # type: ignore[attr-defined]
+    return surface
+
+
+# ---------------------------------------------------------------------------
+# contract snapshot
+# ---------------------------------------------------------------------------
+
+
+def build_contract(surface: RpcSurface) -> dict:
+    """Line-number-free wire-surface snapshot: op → handler signatures +
+    caller files, per plane. Changes exactly when the protocol changes."""
+    contract: dict = {"version": 1, "frame": {}, "actor": {}, "doorbell": {}}
+    callers: Dict[Tuple[str, str], Set[str]] = {}
+    for call in surface.calls:
+        callers.setdefault((call.plane, call.op), set()).add(
+            call.src.display_path
+        )
+    for op, hs in surface.frame_handlers.items():
+        contract["frame"][op] = {
+            "handlers": sorted(
+                (h.contract_entry() for h in hs),
+                key=lambda e: (e["path"], e["cls"]),
+            ),
+            "callers": sorted(callers.get(("frame", op), ())),
+        }
+    # actor plane: the wire-reachable surface is the spawned classes' public
+    # methods; dispatched ops resolved only through the fallback inventory
+    # (spawn site out of scope) still enter the contract via their callers
+    actor_ops = set(surface.actor_handlers)
+    actor_ops.update(
+        op for (plane, op) in callers if plane == "actor"
+    )
+    for op in actor_ops:
+        hs = surface.actor_handlers.get(op, [])
+        contract["actor"][op] = {
+            "handlers": sorted(
+                (h.contract_entry() for h in hs),
+                key=lambda e: (e["path"], e["cls"]),
+            ),
+            "callers": sorted(callers.get(("actor", op), ())),
+        }
+    for op, hs in surface.doorbell_handlers.items():
+        contract["doorbell"][op] = {
+            "handlers": sorted(
+                ({"path": h.src.display_path} for h in hs),
+                key=lambda e: e["path"],
+            ),
+            "callers": sorted(callers.get(("doorbell", op), ())),
+        }
+    return contract
+
+
+def render_contract(contract: dict) -> str:
+    return json.dumps(contract, indent=2, sort_keys=True) + "\n"
+
+
+def check_contract(surface: RpcSurface, committed: dict) -> List[str]:
+    """Human-readable mismatches between the live surface and the committed
+    contract (empty = in sync). Every line names the op and the fix."""
+    problems: List[str] = []
+    live = build_contract(surface)
+    for plane in ("frame", "actor", "doorbell"):
+        live_ops = live.get(plane, {})
+        committed_ops = committed.get(plane, {})
+        for op in sorted(set(live_ops) - set(committed_ops)):
+            problems.append(
+                f"{plane} op '{op}' exists in the tree but not in the "
+                "committed contract — run --write-contract and commit the diff"
+            )
+        for op in sorted(set(committed_ops) - set(live_ops)):
+            problems.append(
+                f"{plane} op '{op}' is in the committed contract but no "
+                "longer in the tree — run --write-contract and commit the diff"
+            )
+        for op in sorted(set(live_ops) & set(committed_ops)):
+            if live_ops[op] != committed_ops[op]:
+                problems.append(
+                    f"{plane} op '{op}' drifted from the committed contract "
+                    "(signature or caller set changed) — run --write-contract "
+                    "and commit the diff"
+                )
+    return problems
+
+
+# ---------------------------------------------------------------------------
+# docs table
+# ---------------------------------------------------------------------------
+
+RPC_TABLE_BEGIN = "<!-- rpc-surface:begin (generated: python -m tools.analyze --write-rpc-table) -->"
+RPC_TABLE_END = "<!-- rpc-surface:end -->"
+
+
+def render_rpc_table(surface: RpcSurface) -> str:
+    """Markdown table op → caller files → handler ``file:line`` (frame +
+    doorbell planes, plus dispatched actor ops — the actual wire traffic)."""
+    callers: Dict[Tuple[str, str], Set[str]] = {}
+    for call in surface.calls:
+        callers.setdefault((call.plane, call.op), set()).add(
+            call.src.display_path
+        )
+    rows: List[Tuple[str, str, str, str]] = []
+    for op, hs in surface.frame_handlers.items():
+        rows.append(("frame", op, *_table_cells(hs, callers.get(("frame", op)))))
+    for op, hs in surface.doorbell_handlers.items():
+        rows.append(
+            ("doorbell", op, *_table_cells(hs, callers.get(("doorbell", op))))
+        )
+    for (plane, op), files in callers.items():
+        if plane != "actor":
+            continue
+        hs = surface.actor_handlers.get(op) or surface.class_methods.get(op, [])
+        rows.append(("actor", op, *_table_cells(hs, files)))
+    rows.sort()
+    lines = [
+        "| plane | op | caller files | handler |",
+        "|---|---|---|---|",
+    ]
+    for plane, op, caller_cell, handler_cell in rows:
+        lines.append(f"| {plane} | `{op}` | {caller_cell} | {handler_cell} |")
+    return "\n".join(lines)
+
+
+def _table_cells(
+    handlers: List[Handler], caller_files: Optional[Set[str]]
+) -> Tuple[str, str]:
+    caller_cell = (
+        "<br>".join(f"`{p}`" for p in sorted(caller_files))
+        if caller_files
+        else "—"
+    )
+    handler_cell = (
+        "<br>".join(
+            f"`{h.src.display_path}:{h.node.lineno}`"
+            + (f" `{h.cls}`" if h.cls else "")
+            for h in handlers
+        )
+        if handlers
+        else "—"
+    )
+    return caller_cell, handler_cell

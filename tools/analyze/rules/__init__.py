@@ -10,7 +10,11 @@ from tools.analyze.rules.guarded_by import GuardedByRule
 from tools.analyze.rules.lock_order import LockOrderRule
 from tools.analyze.rules.metric_registry import MetricRegistryRule
 from tools.analyze.rules.print_diagnostics import PrintDiagnosticsRule
+from tools.analyze.rules.rpc_closure import RpcClosureRule
 from tools.analyze.rules.rpc_error_safety import RpcErrorSafetyRule
+from tools.analyze.rules.rpc_lock_flow import RpcLockFlowRule
+from tools.analyze.rules.rpc_no_reply import RpcNoReplyRule
+from tools.analyze.rules.rpc_payload_safety import RpcPayloadSafetyRule
 from tools.analyze.rules.rpc_protocol import RpcProtocolRule
 from tools.analyze.rules.swallowed_exceptions import SwallowedExceptionsRule
 
@@ -27,6 +31,10 @@ ALL_RULES = (
     EnvRegistryRule,
     RpcErrorSafetyRule,
     ExceptOrderRule,
+    RpcClosureRule,
+    RpcPayloadSafetyRule,
+    RpcNoReplyRule,
+    RpcLockFlowRule,
 )
 
 

@@ -1,0 +1,161 @@
+"""rpc-closure: the wire surface is closed — every send has a handler,
+every handler a sender, and every call shape binds its signature.
+
+Built on the project-wide RPC surface (:mod:`tools.analyze.rpc`), which
+covers all three planes; ``rpc-protocol`` (the v1 rule) keeps its original
+frame/actor checks, and this rule extends closure to the full extracted
+surface:
+
+- **unknown op** — a frame call whose op no server handles, an actor
+  dispatch no project class defines, or a doorbell frame no server loop
+  answers: the call fails at runtime with a stringly-typed AttributeError.
+- **dead wire surface** — a frame ``handle_*`` or doorbell op with no
+  statically-visible sender. Dead FRAME/DOORBELL surface only: actor-plane
+  methods are also ordinary Python methods callable in-process, so a
+  no-``.remote``-site method is not evidence of dead protocol. Suppress on
+  the handler line for operator/debug surfaces exercised only reflectively.
+- **arity/kwarg mismatch** — a frame call whose literal kwargs no handler
+  binds (``**kwargs``-tolerant handlers accept anything), or an actor
+  dispatch whose positional/keyword shape the SPAWNED target class cannot
+  bind (when exactly one spawned class defines the method; ambiguous names
+  and ``*``-spreads are skipped — under-reporting beats mis-attributing).
+- **timeout ``or``-default idiom** (lint note) — ``timeout or 300.0`` maps
+  an explicit ``timeout=0`` to the default; write
+  ``300.0 if timeout is None else timeout``.
+
+The committed contract snapshot (``rpc_contract.json``, ``--check-contract``)
+gates the same surface in CI: this rule closes it within a revision, the
+contract pins it across revisions.
+"""
+
+from __future__ import annotations
+
+from typing import List, Set
+
+from tools.analyze.core import Finding, Project
+
+
+class RpcClosureRule:
+    """Wire-surface closure: unknown ops, dead handlers, arity mismatches,
+    and the timeout `or`-default idiom, across all three RPC planes."""
+
+    name = "rpc-closure"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        surface = project.rpc_surface()
+        self._check_frame(surface, findings)
+        self._check_actor(surface, findings)
+        self._check_doorbell(surface, findings)
+        for site in surface.timeout_or_sites:
+            findings.append(
+                site.src.finding(
+                    self.name, site.node,
+                    f"`{site.name} or <default>` in {site.func_name} maps an "
+                    "explicit 0/falsy timeout to the default — use "
+                    f"`<default> if {site.name} is None else {site.name}`",
+                )
+            )
+        return findings
+
+    def _check_frame(self, surface, findings: List[Finding]) -> None:
+        handlers = surface.frame_handlers
+        if not handlers:
+            # nothing serves the frame plane in this scan (fixture subset):
+            # call sites alone cannot be validated
+            return
+        called: Set[str] = set()
+        for site in surface.calls_on("frame"):
+            called.add(site.op)
+            cands = handlers.get(site.op)
+            if not cands:
+                findings.append(
+                    site.src.finding(
+                        self.name, site.node,
+                        f"unknown frame op '{site.op}': no handle_{site.op} "
+                        "on any protocol server",
+                    )
+                )
+                continue
+            if site.kwargs is not None and not any(
+                h.binds_kwargs(site.kwargs) for h in cands
+            ):
+                sigs = "; ".join(h.signature() for h in cands)
+                sent = ", ".join(sorted(site.kwargs)) or "<none>"
+                findings.append(
+                    site.src.finding(
+                        self.name, site.node,
+                        f"frame op '{site.op}' arity mismatch: call sends "
+                        f"({sent}) but no handler binds it — {sigs}",
+                    )
+                )
+        for op, hs in sorted(handlers.items()):
+            if op in called:
+                continue
+            for h in hs:
+                findings.append(
+                    h.src.finding(
+                        self.name, h.node,
+                        f"dead wire surface: {h.cls}.handle_{op} has no "
+                        "statically-visible rpc/rpc_pooled/head_rpc sender",
+                    )
+                )
+
+    def _check_actor(self, surface, findings: List[Finding]) -> None:
+        if not surface.class_methods:
+            return
+        for site in surface.calls_on("actor"):
+            spawned = surface.actor_handlers.get(site.op)
+            cands = spawned or surface.class_methods.get(site.op)
+            if not cands:
+                findings.append(
+                    site.src.finding(
+                        self.name, site.node,
+                        f"unknown actor method '{site.op}': no project class "
+                        "defines it",
+                    )
+                )
+                continue
+            if (
+                not spawned
+                or len(spawned) != 1
+                or site.n_pos < 0
+                or site.kwargs is None
+            ):
+                continue  # ambiguous target or spread args: arity unknowable
+            h = spawned[0]
+            if not h.binds_call(site.n_pos, site.kwargs):
+                sent = ", ".join(
+                    [f"<{site.n_pos} positional>"] + sorted(site.kwargs)
+                )
+                findings.append(
+                    site.src.finding(
+                        self.name, site.node,
+                        f"actor arity mismatch for '{site.op}': call sends "
+                        f"({sent}) but {h.signature()} cannot bind it",
+                    )
+                )
+
+    def _check_doorbell(self, surface, findings: List[Finding]) -> None:
+        handlers = surface.doorbell_handlers
+        called = {s.op for s in surface.calls_on("doorbell")}
+        for site in surface.calls_on("doorbell"):
+            if handlers and site.op not in handlers:
+                findings.append(
+                    site.src.finding(
+                        self.name, site.node,
+                        f"unknown doorbell op '{site.op}': no server loop "
+                        "answers it",
+                    )
+                )
+        for op, hs in sorted(handlers.items()):
+            if op in called:
+                continue
+            for h in hs:
+                findings.append(
+                    h.src.finding(
+                        self.name, h.node,
+                        f"dead doorbell surface: '{op}' is answered here but "
+                        "no statically-visible frame sends it",
+                    )
+                )

@@ -1,0 +1,239 @@
+"""rpc-lock-flow: no handler blocks on the wire while holding a named lock —
+checked THROUGH the call graph, not just lexically.
+
+The cross-process deadlock shape: head handler holds ``head.lock`` and RPCs
+an agent; the agent's handler needs something from the head; both control
+planes freeze. Runtime lockdep only sees it when it actually deadlocks, and
+``blocking-under-lock`` only sees the LEXICAL case (an ``rpc(...)`` directly
+inside the ``with self.lock:`` block). This rule marries the lock model
+(:mod:`tools.analyze.locks`) to the extracted RPC surface
+(:mod:`tools.analyze.rpc`) and flags the interprocedural case: an RPC
+**handler** (frame plane, or a spawned class's wire-reachable method) that,
+while a resolved lock is held, calls a helper which — transitively, through
+same-file ``self.method()`` / module-function calls — performs an outbound
+RPC (``rpc``/``rpc_pooled``/``head_rpc``), a socket send
+(``.sendall``/``.sendto``/``send_frame``), or an unbounded cond-``wait()``.
+
+Deliberate scope cuts (each avoids a class of false positives):
+
+- depth ≥ 1 only — the direct lexical case is blocking-under-lock's finding;
+  double-reporting would force double suppressions.
+- nested defs/lambdas inside a callee do not count as that callee's outbound
+  ops (the package idiom runs slow agent RPCs on daemon threads precisely to
+  get them off-lock — see ``Head._spawn``/``_kill_proc``).
+- an outbound op on a line already carrying a ``blocking-under-lock`` or
+  ``rpc-lock-flow`` suppression is trusted (the reasoning there covers the
+  callers too).
+- call resolution is same-file only (``self.m()`` to the handler's class,
+  bare ``f()`` to module functions); cross-file flow is out of scope —
+  under-reporting beats mis-attributed deadlock reports.
+
+Fix like ``Head._unlink_objects``: snapshot under the lock, send outside.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from tools.analyze.core import Finding, Project, SourceFile, call_name
+from tools.analyze.locks import (
+    HeldStackWalker,
+    _annotations,
+    entry_held,
+    get_lock_model,
+    iter_class_functions,
+    module_of,
+)
+from tools.analyze.rpc import own_nodes
+
+_RPC_NAMES = {"rpc", "rpc_pooled", "head_rpc"}
+_SEND_ATTRS = {"sendall", "sendto"}
+_SEND_FUNCS = {"send_frame"}
+
+
+def _outbound_desc(node: ast.Call) -> Optional[str]:
+    """Why this call talks to another process (or parks), else None."""
+    name = call_name(node)
+    terminal = name.rsplit(".", 1)[-1] if name else None
+    attr = node.func.attr if isinstance(node.func, ast.Attribute) else None
+    if terminal in _RPC_NAMES:
+        return f"outbound RPC '{terminal}(...)'"
+    if attr in _SEND_ATTRS:
+        return f"socket send '.{attr}(...)'"
+    if terminal in _SEND_FUNCS:
+        return f"frame send '{terminal}(...)'"
+    if attr == "wait" and not node.args and not node.keywords:
+        return "unbounded '.wait()'"
+    return None
+
+
+class _CallGraph:
+    """Per-file transitive outbound-op index over class methods and module
+    functions. ``witness(key)`` is the first outbound op reachable from the
+    function, as a chain description, or None."""
+
+    def __init__(self, src: SourceFile):
+        self.src = src
+        # (class_or_None, name) -> funcdef
+        self.functions: Dict[Tuple[Optional[str], str], ast.AST] = {}
+        if src.tree is not None:
+            for cls, fn in iter_class_functions(src.tree):
+                self.functions.setdefault((cls, fn.name), fn)
+        self._memo: Dict[Tuple[Optional[str], str], Optional[str]] = {}
+
+    def _direct_outbound(self, fn: ast.AST) -> Optional[Tuple[str, int]]:
+        for node in own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            desc = _outbound_desc(node)
+            if desc is None:
+                continue
+            line = getattr(node, "lineno", 0)
+            if self.src.is_suppressed(
+                "blocking-under-lock", line
+            ) or self.src.is_suppressed("rpc-lock-flow", line):
+                continue  # an already-reasoned hold covers its callers too
+            return desc, line
+        return None
+
+    def callees(self, fn: ast.AST, cls: Optional[str]):
+        """(key, callee_name) for same-file calls in fn's own body."""
+        for node in own_nodes(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = call_name(node)
+            if name is None:
+                continue
+            parts = name.split(".")
+            if len(parts) == 2 and parts[0] == "self" and cls is not None:
+                key = (cls, parts[1])
+            elif len(parts) == 1:
+                key = (None, parts[0])
+            else:
+                continue
+            if key in self.functions:
+                yield key, name
+
+    def witness(self, key: Tuple[Optional[str], str], _stack=None) -> Optional[str]:
+        """Chain description 'a() -> b() -> rpc(...) at file:line' when the
+        function TRANSITIVELY reaches an outbound op, else None. Direct ops
+        in the entry function itself are NOT its witness (depth ≥ 1 is the
+        caller's concern; blocking-under-lock owns depth 0) — but they ARE
+        once reached through a call edge."""
+        if key in self._memo:
+            return self._memo[key]
+        if _stack is None:
+            _stack = set()
+        if key in _stack:
+            return None  # recursion cycle
+        fn = self.functions.get(key)
+        if fn is None:
+            return None
+        _stack.add(key)
+        result: Optional[str] = None
+        direct = self._direct_outbound(fn)
+        if direct is not None:
+            desc, line = direct
+            result = f"{desc} at {self.src.display_path}:{line}"
+        else:
+            for callee_key, callee_name in self.callees(fn, key[0]):
+                inner = self.witness(callee_key, _stack)
+                if inner is not None:
+                    result = f"{callee_name}() -> {inner}"
+                    break
+        _stack.discard(key)
+        self._memo[key] = result
+        return result
+
+
+class _FlowWalker(HeldStackWalker):
+    """While any lock is held, flag calls whose same-file callee transitively
+    performs an outbound op (the callee's own nested-thread bodies excluded)."""
+
+    def __init__(self, rule, src, model, annotations, class_name, module,
+                 func_name, held, findings, graph):
+        super().__init__(
+            src, model, annotations, class_name, module, func_name, held
+        )
+        self.rule = rule
+        self.findings = findings
+        self.graph = graph
+
+    def _clone(self, func_name, held):
+        return _FlowWalker(
+            self.rule, self.src, self.model, self.annotations,
+            self.class_name, self.module, func_name, held, self.findings,
+            self.graph,
+        )
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.held and _outbound_desc(node) is None:
+            name = call_name(node)
+            if name is not None:
+                parts = name.split(".")
+                key = None
+                if len(parts) == 2 and parts[0] == "self" and self.class_name:
+                    key = (self.class_name, parts[1])
+                elif len(parts) == 1:
+                    key = (None, parts[0])
+                if key is not None:
+                    chain = self.graph.witness(key)
+                    if chain is not None:
+                        locks = ", ".join(
+                            f"'{n}' ({site})" for n, site in self.held
+                        )
+                        self.findings.append(
+                            self.src.finding(
+                                self.rule.name, node,
+                                f"handler {self.func_name} performs "
+                                f"{name}() -> {chain} while holding {locks} "
+                                "— snapshot under the lock, send outside "
+                                "(the cross-process deadlock shape)",
+                            )
+                        )
+        self.generic_visit(node)
+
+
+class RpcLockFlowRule:
+    """RPC handlers that reach an outbound RPC/socket send/cond-wait through
+    helper calls while holding a named lock (interprocedural; the lexical
+    case is blocking-under-lock's)."""
+
+    name = "rpc-lock-flow"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        surface = project.rpc_surface()
+        model = get_lock_model(project)
+        # entry points: frame handlers + spawned classes' wire-reachable
+        # methods — the functions another PROCESS invokes
+        entries: Dict[str, List] = {}
+        for handlers in list(surface.frame_handlers.values()) + list(
+            surface.actor_handlers.values()
+        ):
+            for h in handlers:
+                entries.setdefault(h.src.display_path, []).append(h)
+        graphs: Dict[str, _CallGraph] = {}
+        seen: Set[int] = set()
+        for path, handlers in entries.items():
+            for h in handlers:
+                if id(h.node) in seen:
+                    continue
+                seen.add(id(h.node))
+                src = h.src
+                if path not in graphs:
+                    graphs[path] = _CallGraph(src)
+                annotations = _annotations(src)
+                module = module_of(src)
+                held = entry_held(
+                    h.node, annotations, model, h.cls or None, module, src
+                )
+                walker = _FlowWalker(
+                    self, src, model, annotations, h.cls or None, module,
+                    getattr(h.node, "name", h.op), held, findings,
+                    graphs[path],
+                )
+                for stmt in h.node.body:
+                    walker.visit(stmt)
+        return findings

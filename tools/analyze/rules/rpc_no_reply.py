@@ -1,0 +1,58 @@
+"""rpc-no-reply: a fire-and-forget send must not discard a real reply.
+
+``handle.method.options(no_reply=True).remote(...)`` (and a direct
+``_call(..., no_reply=True)``) tells the actor server to skip the reply
+frame entirely — the caller gets a ``_CompletedFuture`` whose ``.result()``
+is always ``None``. That is correct for acks, but if the target method
+computes and returns a value, the contract silently breaks: the caller
+*thinks* it has a result channel and reads ``None`` forever, and the
+breakage only shows where the value is finally used, far from the send.
+
+The rule resolves every ``no_reply=True`` dispatch on the extracted surface
+(:mod:`tools.analyze.rpc`) against its target: spawned classes' methods
+first, any project class as fallback. A target whose body returns a
+non-constant expression (bare ``return True``/``"pong"`` acks are fine to
+drop) is flagged. Fix by converting to a replied call, changing the handler
+to return nothing, or suppressing on the send line with the reasoning that
+makes the dropped value intentional.
+
+No current call site uses ``no_reply=True`` (audited in this PR — the
+mechanism exists in ``RemoteMethod.options`` but nothing exercises it yet);
+the rule pins the invariant for when one appears.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tools.analyze.core import Finding, Project
+
+
+class RpcNoReplyRule:
+    """`no_reply=True` sends targeting handlers whose return value is
+    meaningful (a dropped reply is a silent contract break)."""
+
+    name = "rpc-no-reply"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        surface = project.rpc_surface()
+        for site in surface.calls:
+            if not site.no_reply:
+                continue
+            cands = surface.actor_handlers.get(site.op) or (
+                surface.class_methods.get(site.op, [])
+            )
+            for h in cands:
+                if not h.returns_value:
+                    continue
+                findings.append(
+                    site.src.finding(
+                        self.name, site.node,
+                        f"no_reply=True send of '{site.op}' discards the "
+                        f"return value of {h.signature()} "
+                        f"({h.src.display_path}:{h.node.lineno}) — use a "
+                        "replied call, or make the handler return nothing",
+                    )
+                )
+        return findings

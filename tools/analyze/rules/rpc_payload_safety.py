@@ -1,0 +1,213 @@
+"""rpc-payload-safety: nothing process-bound crosses the wire.
+
+Every RPC frame is cloudpickled (``send_frame``), so most objects "work" —
+until a payload smuggles process-bound state: a lock pickles into a NEW
+unlocked lock on the peer, a socket/file refuses to pickle at runtime, a
+generator is consumed-once and unpicklable, and a raw jax device array drags
+a device buffer through host sync + transfer on every send. All four are
+invisible at the call site because pickling happens layers below.
+
+The rule inspects, on the extracted RPC surface (:mod:`tools.analyze.rpc`):
+
+- **call-site payloads** — literal frame-plane kwarg values, ``head_rpc``
+  keyword values, and actor-plane ``.remote(...)`` arguments;
+- **handler returns** — return expressions of frame handlers and of spawned
+  classes' public (wire-reachable) methods, plus ``yield`` anywhere in a
+  handler body (the return value would BE a generator).
+
+Flagged payload shapes:
+
+- generator expressions;
+- ``threading`` primitives and ``Thread`` constructions;
+- ``socket.socket(...)`` / ``create_connection(...)`` / bare ``open(...)``;
+- known lock objects (``self.lock`` etc., resolved through the project lock
+  model — the same identities lock-order/blocking-under-lock use);
+- raw jax expressions (``jnp.*`` / ``jax.*``) outside the approved marshaling
+  helpers (``np.asarray``/``np.array``/``jax.device_get``/``.tolist()``/
+  ``.item()``/``float``/``int``/``list``/``bytes``/``to_numpy`` — anything
+  that lands host-side before pickling).
+
+Names are traced one assignment back within the enclosing function when the
+assignment is unique; everything else is out of scope (under-reporting beats
+false positives on a lint gate).
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional
+
+from tools.analyze.core import Finding, Project, SourceFile, call_name
+from tools.analyze.locks import get_lock_model, module_of
+from tools.analyze.rpc import own_nodes
+
+_THREADING_CTORS = {
+    "Lock", "RLock", "Condition", "Semaphore", "BoundedSemaphore",
+    "Event", "Barrier", "Thread",
+}
+_SOCKET_CTORS = {"socket", "create_connection"}
+_JAX_PREFIXES = ("jnp.", "jax.")
+#: call terminals that marshal a device value host-side before pickling
+_APPROVED_MARSHALS = {
+    "asarray", "array", "device_get", "tolist", "item", "float", "int",
+    "list", "bytes", "to_numpy", "dumps",
+}
+
+
+def _classify(expr: ast.AST, env: Dict[str, ast.AST], depth: int = 0) -> Optional[str]:
+    """Why this expression is wire-unsafe, or None. ``env`` maps local names
+    to their unique assignment value (one provenance hop)."""
+    if isinstance(expr, ast.GeneratorExp):
+        return "a generator expression (consumed-once, unpicklable)"
+    if isinstance(expr, ast.Call):
+        name = call_name(expr)
+        if name is None:
+            return None
+        terminal = name.rsplit(".", 1)[-1]
+        if terminal in _APPROVED_MARSHALS:
+            return None  # marshaled host-side: safe by construction
+        if terminal in _THREADING_CTORS:
+            return f"a threading primitive ({name}(...))"
+        if terminal in _SOCKET_CTORS or name == "open":
+            return f"an OS handle ({name}(...))"
+        if name.startswith(_JAX_PREFIXES):
+            return (
+                f"a raw jax value ({name}(...)) — marshal host-side first "
+                "(np.asarray / jax.device_get / .tolist())"
+            )
+        return None
+    if isinstance(expr, ast.Name) and depth == 0:
+        assigned = env.get(expr.id)
+        if assigned is not None:
+            why = _classify(assigned, env, depth=1)
+            if why is not None:
+                return f"'{expr.id}', assigned {why}"
+    return None
+
+
+def _local_env(fn: ast.AST) -> Dict[str, ast.AST]:
+    """name -> value for locals assigned EXACTLY once in fn's own body."""
+    counts: Dict[str, int] = {}
+    values: Dict[str, ast.AST] = {}
+    for node in own_nodes(fn):
+        if isinstance(node, ast.Assign):
+            for target in node.targets:
+                if isinstance(target, ast.Name):
+                    counts[target.id] = counts.get(target.id, 0) + 1
+                    values[target.id] = node.value
+    return {k: v for k, v in values.items() if counts.get(k) == 1}
+
+
+def _enclosing_functions(src: SourceFile):
+    """(funcdef, class_name) for every function, innermost last, so a payload
+    node can be matched to its tightest enclosing scope."""
+    out = []
+
+    def walk(node: ast.AST, cls: Optional[str]) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.ClassDef):
+                walk(child, child.name)
+            elif isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                out.append((child, cls))
+                walk(child, cls)
+            else:
+                walk(child, cls)
+
+    if src.tree is not None:
+        walk(src.tree, None)
+    return out
+
+
+class RpcPayloadSafetyRule:
+    """Process-bound state (locks, sockets, threads, generators, raw jax
+    arrays) in RPC call-site payloads or handler returns."""
+
+    name = "rpc-payload-safety"
+
+    def check_project(self, project: Project) -> List[Finding]:
+        findings: List[Finding] = []
+        surface = project.rpc_surface()
+        model = get_lock_model(project)
+        # call-site payloads, resolved in their enclosing function's scope
+        env_cache: Dict[int, Dict[str, ast.AST]] = {}
+        scopes: Dict[str, List] = {}
+        for call in surface.calls:
+            src = call.src
+            if src.display_path not in scopes:
+                scopes[src.display_path] = _enclosing_functions(src)
+            fn, cls = _enclosing(scopes[src.display_path], call.node)
+            env = {}
+            if fn is not None:
+                if id(fn) not in env_cache:
+                    env_cache[id(fn)] = _local_env(fn)
+                env = env_cache[id(fn)]
+            module = module_of(src)
+            for payload in call.payloads:
+                why = _classify(payload, env)
+                if why is None:
+                    lock = model.resolve(payload, cls, module)
+                    if lock is not None:
+                        why = (
+                            f"the lock '{lock}' (pickles into a NEW unlocked "
+                            "lock on the peer)"
+                        )
+                if why is not None:
+                    findings.append(
+                        src.finding(
+                            self.name, payload,
+                            f"'{call.op}' payload ships {why} — not wire-safe",
+                        )
+                    )
+        # handler returns (frame plane + spawned classes' public methods)
+        seen: set = set()
+        for handlers in list(surface.frame_handlers.values()) + list(
+            surface.actor_handlers.values()
+        ):
+            for h in handlers:
+                if id(h.node) in seen:
+                    continue
+                seen.add(id(h.node))
+                if h.has_yield:
+                    findings.append(
+                        h.src.finding(
+                            self.name, h.node,
+                            f"handler {h.signature()} is a generator — its "
+                            "'return value' cannot cross the wire",
+                        )
+                    )
+                    continue
+                env = _local_env(h.node)
+                module = module_of(h.src)
+                for node in own_nodes(h.node):
+                    if not isinstance(node, ast.Return) or node.value is None:
+                        continue
+                    why = _classify(node.value, env)
+                    if why is None:
+                        lock = model.resolve(node.value, h.cls, module)
+                        if lock is not None:
+                            why = f"the lock '{lock}'"
+                    if why is not None:
+                        findings.append(
+                            h.src.finding(
+                                self.name, node,
+                                f"handler {h.signature()} returns {why} — "
+                                "not wire-safe",
+                            )
+                        )
+        return findings
+
+
+def _enclosing(scopes, node: ast.AST):
+    """The innermost (funcdef, class_name) whose span contains node."""
+    line = getattr(node, "lineno", None)
+    if line is None:
+        return None, None
+    best = (None, None)
+    best_span = None
+    for fn, cls in scopes:
+        end = getattr(fn, "end_lineno", fn.lineno)
+        if fn.lineno <= line <= end:
+            span = end - fn.lineno
+            if best_span is None or span <= best_span:
+                best, best_span = (fn, cls), span
+    return best
